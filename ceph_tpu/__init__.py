@@ -1,0 +1,18 @@
+"""ceph_tpu — a TPU-native distributed object-storage framework.
+
+A from-scratch rebuild of Ceph's capability surface (reference:
+ssdohammer-sl/ceph @ 2024-08-07) designed TPU-first: the erasure-code and
+checksum hot paths run as JAX/Pallas GF(2) matmul kernels on TPU, the cluster
+runtime (messenger, CRUSH placement, Paxos monitors, PG-based OSDs, client
+library) is rebuilt idiomatically rather than ported.
+
+Subpackages:
+  ec        erasure-code plugin layer (interface, registry, plugins)
+  ops       device kernels (RS bitplane matmul, crc32c, Pallas variants)
+  parallel  device-mesh sharding of the codec pipeline (ICI scale-out)
+  rados     cluster core (crush, maps, messenger, mon, osd, client)
+  utils     runtime substrate (buffers, config, perf counters, logging)
+  tools     CLIs (ec benchmark, object store tools)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Mirrors the reference's approach of exercising multi-daemon behavior on one
+host (qa/standalone/ceph-helpers.sh): we exercise multi-chip sharding on one
+host via XLA's virtual CPU devices. Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

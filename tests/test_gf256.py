@@ -1,0 +1,115 @@
+"""GF(2^8) field and matrix math tests (host control plane)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import gf256
+
+
+def test_field_axioms():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+        assert gf256.gf_mul(a, gf256.gf_mul(b, c)) == gf256.gf_mul(gf256.gf_mul(a, b), c)
+        # distributivity over XOR (field addition)
+        assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+    assert gf256.gf_mul(0, 77) == 0
+    assert gf256.gf_mul(1, 77) == 77
+
+
+def test_inverse_and_div():
+    for a in range(1, 256):
+        assert gf256.gf_mul(a, gf256.gf_inv(a)) == 1
+        assert gf256.gf_div(a, a) == 1
+    with pytest.raises(ZeroDivisionError):
+        gf256.gf_inv(0)
+
+
+def test_primitive_polynomial_is_0x11d():
+    # alpha = 2; 2^8 must reduce to 0x11D ^ 0x100 = 0x1D
+    assert gf256.gf_pow(2, 8) == 0x1D
+    # field generator has full order 255
+    seen = {gf256.gf_pow(2, i) for i in range(255)}
+    assert len(seen) == 255
+
+
+def test_mat_invert_roundtrip():
+    rng = np.random.default_rng(1)
+    for n in (1, 2, 5, 8):
+        while True:
+            M = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                inv = gf256.mat_invert(M)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        ident = gf256.mat_mul(M, inv)
+        assert np.array_equal(ident, np.eye(n, dtype=np.uint8))
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (3, 2), (4, 2), (6, 3), (8, 3), (8, 4), (10, 4)])
+def test_reed_sol_van_is_mds(k, m):
+    """Every k-subset of generator rows must be invertible (MDS property)."""
+    import itertools
+
+    coding = gf256.reed_sol_van_matrix(k, m)
+    gen = np.vstack([np.eye(k, dtype=np.uint8), coding])
+    rows = list(range(k + m))
+    # exhaustive for small geometries, sampled for larger ones
+    combos = list(itertools.combinations(rows, k))
+    if len(combos) > 300:
+        rng = np.random.default_rng(2)
+        combos = [combos[i] for i in rng.choice(len(combos), 300, replace=False)]
+    for combo in combos:
+        gf256.mat_invert(gen[list(combo)])  # raises if singular
+
+
+@pytest.mark.parametrize("maker,km", [
+    (gf256.cauchy_orig_matrix, (8, 3)),
+    (gf256.cauchy_good_matrix, (8, 3)),
+    (gf256.cauchy_orig_matrix, (4, 2)),
+    (gf256.cauchy_good_matrix, (4, 2)),
+    (gf256.isa_cauchy1_matrix, (8, 3)),
+])
+def test_cauchy_is_mds(maker, km):
+    import itertools
+
+    k, m = km
+    coding = maker(k, m)
+    gen = np.vstack([np.eye(k, dtype=np.uint8), coding])
+    for combo in itertools.combinations(range(k + m), k):
+        gf256.mat_invert(gen[list(combo)])
+
+
+def test_r6_matrix():
+    coding = gf256.reed_sol_r6_matrix(5)
+    assert np.array_equal(coding[0], np.ones(5, dtype=np.uint8))
+    assert list(coding[1]) == [gf256.gf_pow(2, j) for j in range(5)]
+
+
+def test_bitmatrix_equivalence():
+    """Bitmatrix application over bit-planes == GF(2^8) byte multiply."""
+    rng = np.random.default_rng(3)
+    M = gf256.reed_sol_van_matrix(4, 2)
+    B = gf256.matrix_to_bitmatrix(M)
+    data = rng.integers(0, 256, (4, 64)).astype(np.uint8)
+    want = gf256.mat_vec_apply(M, data)
+    # bit-plane expansion
+    planes = ((data[:, None, :] >> np.arange(8)[None, :, None]) & 1).reshape(32, 64)
+    out_planes = (B.astype(np.int32) @ planes.astype(np.int32)) & 1
+    got = np.zeros((2, 64), dtype=np.uint8)
+    for r in range(8):
+        got |= (out_planes.reshape(2, 8, 64)[:, r, :] << r).astype(np.uint8)
+    assert np.array_equal(got, want)
+
+
+def test_bitmatrix_invert():
+    rng = np.random.default_rng(4)
+    while True:
+        X = rng.integers(0, 2, (16, 16)).astype(np.uint8)
+        try:
+            Xi = gf256.bitmatrix_invert(X)
+            break
+        except np.linalg.LinAlgError:
+            continue
+    assert np.array_equal((X.astype(np.int32) @ Xi.astype(np.int32)) % 2, np.eye(16, dtype=np.int32))
