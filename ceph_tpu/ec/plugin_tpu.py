@@ -1,0 +1,113 @@
+"""`tpu` plugin — the flagship erasure code, designed for the accelerator.
+
+This is the plugin the north-star benchmark targets (BASELINE.json): the
+reference's `ErasureCodeInterface::encode_chunks` contract, but engineered
+around TPU realities measured on hardware:
+
+  * the bitplane-matmul kernel sustains hundreds of GiB/s device-resident,
+  * a single host<->device round trip costs ~2 ms through the transfer
+    tunnel, i.e. one unbatched 1 MiB-stripe dispatch would be ~0.01 GiB/s.
+
+So the plugin exposes, beyond the scalar interface:
+  - encode_stripes/decode_stripes: (batch, k, S) one-dispatch batch APIs —
+    the ECUtil::encode stripe loop (reference src/osd/ECUtil.cc:134) maps
+    here, amortizing transfer and launch across concurrent RMW pipelines;
+  - pipelined host-buffer encode with split batches so H2D of batch i+1
+    overlaps compute of batch i (double buffering);
+  - device-resident mode for callers that keep chunks in HBM (the OSD
+    bridge and the benchmark steady state).
+
+Techniques: reed_sol_van (default, byte-compatible with jerasure),
+cauchy_good. Chunk bytes are identical to the jerasure plugin's for the
+same technique, so `tpu` can decode stripes encoded by `jerasure` and
+vice versa.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.ec import gf256
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.plugin_jerasure import ErasureCodeJerasure
+from ceph_tpu.ec.registry import (ERASURE_CODE_VERSION, ErasureCodePlugin,
+                                  ErasureCodePluginRegistry)
+from ceph_tpu.ops import rs_codec
+
+__erasure_code_version__ = ERASURE_CODE_VERSION
+
+DEFAULT_K = 8
+DEFAULT_M = 3
+
+
+class ErasureCodeTpu(ErasureCodeJerasure):
+    technique = "reed_sol_van"
+
+    def init(self, profile: Mapping[str, str]) -> None:
+        profile = dict(profile)
+        profile.setdefault("k", str(DEFAULT_K))
+        profile.setdefault("m", str(DEFAULT_M))
+        super().init(profile)
+        # pipeline depth for host-buffer batches (number of sub-batches whose
+        # transfers overlap compute); 1 disables double buffering
+        self.pipeline_depth = self.to_int("pipeline-depth", profile, 4, minimum=1)
+
+    def _build_matrix(self) -> np.ndarray:
+        if self._profile.get("technique", "reed_sol_van") == "cauchy_good":
+            return gf256.cauchy_good_matrix(self.k, self.m)
+        return gf256.reed_sol_van_matrix(self.k, self.m)
+
+    def _check_technique(self) -> None:
+        tech = self._profile.get("technique", "reed_sol_van")
+        if tech not in ("reed_sol_van", "cauchy_good"):
+            raise ErasureCodeError(f"tpu technique {tech!r} unsupported")
+
+    # -- batched data path ---------------------------------------------------
+
+    def encode_stripes(self, data: np.ndarray | jax.Array) -> np.ndarray | jax.Array:
+        """(batch, k, S) -> (batch, m, S) parity. numpy in => pipelined
+        host transfer + numpy out; device array in => device array out."""
+        if isinstance(data, jax.Array):
+            return self._encoder.apply_batch_device(data)
+        return self._encode_host_pipelined(np.ascontiguousarray(data, dtype=np.uint8))
+
+    def _encode_host_pipelined(self, data: np.ndarray) -> np.ndarray:
+        b = data.shape[0]
+        depth = min(self.pipeline_depth, b)
+        splits = np.array_split(np.arange(b), depth)
+        # enqueue all transfers+dispatches first (async), then collect —
+        # XLA/PJRT overlaps H2D of later sub-batches with earlier compute
+        outs = []
+        for idx in splits:
+            if len(idx) == 0:
+                continue
+            dev = jnp.asarray(data[idx[0]: idx[-1] + 1])
+            outs.append(self._encoder.apply_batch_device(dev))
+        return np.concatenate([np.asarray(o) for o in outs], axis=0)
+
+    def decode_stripes(self, avail_ids: tuple[int, ...], want_ids: tuple[int, ...],
+                       chunks: np.ndarray | jax.Array) -> np.ndarray | jax.Array:
+        """Batched reconstruction: `chunks` is (batch, k, S) holding the
+        available chunks stacked in `avail_ids` order; returns the
+        reconstructed `want_ids` chunks as (batch, len(want), S)."""
+        R = rs_codec.recovery_matrix(self.coding_matrix, avail_ids, want_ids)
+        codec = rs_codec.MatrixCodec.get(R)
+        if isinstance(chunks, jax.Array):
+            return codec.apply_batch_device(chunks)
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+        dev = jnp.asarray(chunks)
+        return np.asarray(codec.apply_batch_device(dev))
+
+
+class ErasureCodePluginTpu(ErasureCodePlugin):
+    def factory(self, profile: Mapping[str, str], directory: str | None = None):
+        instance = ErasureCodeTpu()
+        instance.init(profile)
+        return instance
+
+
+def __erasure_code_init__(name: str, directory: str | None = None):
+    ErasureCodePluginRegistry.instance().add(name, ErasureCodePluginTpu())

@@ -70,6 +70,27 @@ def _apply_bitmatrix_jit(B_i8: jax.Array, data: jax.Array, r: int, k: int) -> ja
     return jnp.sum(out_planes << bits[None, :, None], axis=1, dtype=jnp.int32).astype(jnp.uint8)
 
 
+@functools.partial(jax.jit, static_argnames=("r", "k"))
+def _apply_bitmatrix_batched_jit(B_i8: jax.Array, data: jax.Array, r: int, k: int) -> jax.Array:
+    """data (batch, k, N) uint8 -> (batch, r, N) uint8; one device dispatch
+    for a whole batch of stripes (the ECUtil::encode per-stripe loop becomes
+    one fused kernel — the batching site named in SURVEY §2.2)."""
+    b, _, n = data.shape
+    bits = jnp.asarray(_BITS)
+    planes = ((data[:, :, None, :] >> bits[None, None, :, None]) & 1).astype(jnp.int8)
+    planes = planes.reshape(b, k * 8, n)
+    acc = jax.lax.dot_general(
+        B_i8,
+        planes,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # (r*8, batch, N)
+    out_planes = (acc & 1).astype(jnp.uint8).reshape(r, 8, b, n)
+    out = jnp.sum(out_planes << bits[None, :, None, None], axis=1,
+                  dtype=jnp.int32).astype(jnp.uint8)
+    return out.transpose(1, 0, 2)  # (batch, r, N)
+
+
 class MatrixCodec:
     """Applies one fixed GF(2^8) matrix (r, k) to byte streams on device.
 
@@ -105,6 +126,10 @@ class MatrixCodec:
     def apply_device(self, data: jax.Array) -> jax.Array:
         """data (k, N) uint8 already on device, N already bucket-aligned."""
         return _apply_bitmatrix_jit(self._B, data, self.r, self.k)
+
+    def apply_batch_device(self, data: jax.Array) -> jax.Array:
+        """data (batch, k, N) uint8 on device -> (batch, r, N)."""
+        return _apply_bitmatrix_batched_jit(self._B, data, self.r, self.k)
 
     def apply(self, data: np.ndarray) -> np.ndarray:
         """Host-convenience path: pads, ships to device, returns numpy (r, N)."""

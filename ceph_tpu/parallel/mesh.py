@@ -1,0 +1,133 @@
+"""Multi-chip sharding of the erasure-code pipeline over a device mesh.
+
+Ceph has no tensor/sequence dimensions; its parallelism axes (SURVEY §2
+checklist) map onto a 2D `jax.sharding.Mesh` as:
+
+  axis "stripe" — data parallelism over concurrent stripes (the analog of
+      PG/ShardedThreadPool op-shard parallelism: independent RMW pipelines);
+  axis "shard"  — tensor-parallel analog over the k+m chunk dimension: each
+      device owns a slice of the *parity rows* (the coding bitmatrix is
+      row-sharded) and all-gathers the data chunks over ICI before its
+      partial matmul — the same gather-then-partial-matmul shape as
+      column-parallel TP in ML stacks.
+
+Collectives ride ICI via shard_map (all_gather for chunk assembly, psum for
+stripe-level checksum reduction); inter-host placement stays on the network
+RPC plane (SURVEY §5.8).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ceph_tpu.ec import gf256
+
+_BITS = np.arange(8, dtype=np.uint8)
+
+
+def make_mesh(n_devices: int | None = None, stripe: int | None = None) -> Mesh:
+    """Build a (stripe, shard) mesh over the first n devices."""
+    devs = jax.devices()[: n_devices or len(jax.devices())]
+    n = len(devs)
+    if stripe is None:
+        # favor stripe (DP) parallelism; shard axis gets the residual factor
+        stripe = 1
+        for cand in (8, 4, 2):
+            if n % cand == 0 and cand <= n:
+                stripe = n // cand if n // cand > 0 else 1
+                break
+        if n % 2 == 0 and stripe == 1:
+            stripe = n // 2
+    shard = n // stripe
+    return Mesh(np.asarray(devs).reshape(stripe, shard), ("stripe", "shard"))
+
+
+def _encode_local(B_local: jax.Array, data: jax.Array) -> jax.Array:
+    """Per-device partial encode: all_gather chunks over 'shard', apply the
+    local slice of parity bit-rows. data (b_local, k, N), B_local (rows8, k*8)."""
+    b, k, n = data.shape
+    bits = jnp.asarray(_BITS)
+    planes = ((data[:, :, None, :] >> bits[None, None, :, None]) & 1).astype(jnp.int8)
+    planes = planes.reshape(b, k * 8, n)
+    acc = jax.lax.dot_general(B_local, planes, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    rows = B_local.shape[0] // 8
+    out = (acc & 1).astype(jnp.uint8).reshape(rows, 8, b, n)
+    out = jnp.sum(out << bits[None, :, None, None], axis=1, dtype=jnp.int32).astype(jnp.uint8)
+    return out.transpose(1, 0, 2)  # (b_local, rows, N)
+
+
+def sharded_encode_fn(mesh: Mesh, k: int, m: int, coding: np.ndarray | None = None):
+    """Returns jit(fn(data (B, k, N) uint8) -> (parity (B, m, N), checksum)).
+
+    Stripe batch is sharded over 'stripe'; parity bit-rows over 'shard' (each
+    device computes m*8/shard_size bit-rows after an all_gather of its data
+    slice). Checksum is a psum over both axes — exercises the reduction path
+    used for scrub digests.
+    """
+    if coding is None:
+        coding = gf256.reed_sol_van_matrix(k, m)
+    n_shard = mesh.shape["shard"]
+    # pad parity rows at whole-chunk granularity so each device owns an
+    # integer number of output chunks (m_pad/n_shard each)
+    m_pad = n_shard * -(-m // n_shard)
+    coding_padded = np.zeros((m_pad, k), dtype=np.uint8)
+    coding_padded[:m] = np.asarray(coding, dtype=np.uint8)
+    B = gf256.matrix_to_bitmatrix(coding_padded).astype(np.int8)  # (m_pad*8, k*8)
+    B_dev = jax.device_put(
+        jnp.asarray(B),
+        NamedSharding(mesh, P("shard", None)),
+    )
+
+    def fn(B_local, data):
+        # data arrives (b_local, k, N) on each device; gather stripe-local
+        # batch only — the k axis is fully replicated per device already,
+        # while parity rows are sharded, so each device emits its rows.
+        parity_local = _encode_local(B_local, data)
+        csum = jnp.sum(parity_local.astype(jnp.uint32) * jnp.uint32(2654435761))
+        csum = jax.lax.psum(csum, ("stripe", "shard"))
+        return parity_local, csum
+
+    mapped = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P("shard", None), P("stripe", None, None)),
+        out_specs=(P("stripe", "shard", None), P()),
+        check_rep=False,
+    )
+
+    @jax.jit
+    def encode(data):
+        parity_padded, csum = mapped(B_dev, data)
+        # drop bit-row padding: parity_padded is (B, (m*8+pad)/8, N) bytes
+        return parity_padded[:, :m, :], csum
+
+    return encode
+
+
+def sharded_pipeline_step_fn(mesh: Mesh, k: int, m: int):
+    """Full 'training step' analog for the dry-run: encode sharded stripes,
+    erase m chunks, reconstruct, verify — one jitted step over the mesh."""
+    coding = gf256.reed_sol_van_matrix(k, m)
+    encode = sharded_encode_fn(mesh, k, m, coding)
+
+    # recovery of data chunks 0..m-1 from survivors (ids m..k+m-1)
+    from ceph_tpu.ops import rs_codec
+    avail = tuple(range(m, k + m))
+    want = tuple(range(m))
+    R = rs_codec.recovery_matrix(coding, avail, want)
+    recov = sharded_encode_fn(mesh, k, len(want), R)
+
+    @jax.jit
+    def step(data):
+        parity, csum = encode(data)
+        full = jnp.concatenate([data, parity], axis=1)  # (B, k+m, N)
+        survivors = full[:, m:, :]  # lose chunks 0..m-1
+        rec, _ = recov(survivors)
+        errs = jnp.sum(rec != data[:, :m, :])
+        return errs, csum
+
+    return step

@@ -1,0 +1,180 @@
+"""Plugin-layer tests, modeled on the reference's TestErasureCode*.cc and
+TestErasureCodePlugin.cc (incl. broken-plugin fixtures)."""
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import registry as reg
+from ceph_tpu.ec.interface import ErasureCodeError
+
+
+@pytest.fixture
+def registry():
+    return reg.ErasureCodePluginRegistry.instance()
+
+
+def roundtrip(ec, payload: bytes, erase: tuple[int, ...]) -> bytes:
+    chunk_ids = list(range(ec.get_chunk_count()))
+    encoded = ec.encode(chunk_ids, payload)
+    chunk_size = len(encoded[0])
+    survivors = {i: b for i, b in encoded.items() if i not in erase}
+    return ec.decode_concat(survivors, chunk_size)
+
+
+# -- registry behavior -------------------------------------------------------
+
+def test_factory_profile_roundtrip(registry):
+    ec = registry.factory("jerasure", {"k": "4", "m": "2", "technique": "reed_sol_van"})
+    assert ec.get_data_chunk_count() == 4
+    assert ec.get_coding_chunk_count() == 2
+    assert ec.get_profile()["k"] == "4"
+
+
+def test_factory_unknown_plugin(registry):
+    with pytest.raises(ErasureCodeError, match="no builtin plugin"):
+        registry.factory("doesnotexist", {})
+
+
+def test_factory_bad_profile(registry):
+    with pytest.raises(ErasureCodeError, match="not an integer"):
+        registry.factory("jerasure", {"k": "banana", "m": "2"})
+    with pytest.raises(ErasureCodeError, match="unknown jerasure technique"):
+        registry.factory("jerasure", {"k": "2", "m": "1", "technique": "nope"})
+
+
+def test_plugin_load_failure_fixtures(registry, tmp_path):
+    """Failure-mode fixtures like the reference's ErasureCodePlugin{MissingVersion,
+    MissingEntryPoint,FailToInitialize,FailToRegister}.cc."""
+    (tmp_path / "ec_missingversion.py").write_text("x = 1\n")
+    with pytest.raises(ErasureCodeError, match="missing __erasure_code_version__"):
+        registry.load("missingversion", str(tmp_path))
+
+    (tmp_path / "ec_missingentry.py").write_text(
+        "__erasure_code_version__ = %r\n" % reg.ERASURE_CODE_VERSION)
+    with pytest.raises(ErasureCodeError, match="missing __erasure_code_init__"):
+        registry.load("missingentry", str(tmp_path))
+
+    (tmp_path / "ec_badversion.py").write_text(
+        "__erasure_code_version__ = 'v0-bogus'\n"
+        "def __erasure_code_init__(name, directory):\n    pass\n")
+    with pytest.raises(ErasureCodeError, match="does not match"):
+        registry.load("badversion", str(tmp_path))
+
+    (tmp_path / "ec_failinit.py").write_text(
+        "__erasure_code_version__ = %r\n" % reg.ERASURE_CODE_VERSION +
+        "def __erasure_code_init__(name, directory):\n    return -5\n")
+    with pytest.raises(ErasureCodeError, match="init failed"):
+        registry.load("failinit", str(tmp_path))
+
+    (tmp_path / "ec_noregister.py").write_text(
+        "__erasure_code_version__ = %r\n" % reg.ERASURE_CODE_VERSION +
+        "def __erasure_code_init__(name, directory):\n    return 0\n")
+    with pytest.raises(ErasureCodeError, match="did not register"):
+        registry.load("noregister", str(tmp_path))
+
+    with pytest.raises(ErasureCodeError, match="not found"):
+        registry.load("absentfile", str(tmp_path))
+
+
+def test_preload(registry):
+    registry.preload(["jerasure", "isa", "tpu"])
+    assert registry.get("jerasure") is not None
+    assert registry.get("tpu") is not None
+
+
+# -- encode/decode semantics -------------------------------------------------
+
+PLUGINS = [
+    ("jerasure", {"k": "4", "m": "2", "technique": "reed_sol_van"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "reed_sol_r6_op"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "cauchy_orig"}),
+    ("jerasure", {"k": "4", "m": "2", "technique": "cauchy_good"}),
+    ("isa", {"k": "4", "m": "2", "technique": "reed_sol_van"}),
+    ("isa", {"k": "4", "m": "2", "technique": "cauchy"}),
+    ("tpu", {"k": "4", "m": "2"}),
+]
+
+
+@pytest.mark.parametrize("name,profile", PLUGINS)
+def test_roundtrip_all_single_and_double_erasures(registry, name, profile):
+    ec = registry.factory(name, profile)
+    payload = bytes(np.random.default_rng(5).integers(0, 256, 10_000, dtype=np.uint8))
+    n = ec.get_chunk_count()
+    for nerased in (1, 2):
+        for erase in itertools.combinations(range(n), nerased):
+            got = roundtrip(ec, payload, erase)
+            assert got[: len(payload)] == payload, (name, profile, erase)
+
+
+def test_encode_subset_want(registry):
+    ec = registry.factory("jerasure", {"k": "2", "m": "1"})
+    out = ec.encode([1, 2], b"hello world")
+    assert set(out) == {1, 2}
+
+
+def test_chunk_size_alignment(registry):
+    ec = registry.factory("tpu", {"k": "8", "m": "3"})
+    cs = ec.get_chunk_size(1000)
+    assert cs % 128 == 0 and cs * 8 >= 1000
+    # exact multiples don't over-pad
+    assert ec.get_chunk_size(8 * 128) == 128
+
+
+def test_minimum_to_decode(registry):
+    ec = registry.factory("jerasure", {"k": "3", "m": "2"})
+    # all wanted available -> exactly the wanted set
+    md = ec.minimum_to_decode([0, 1], [0, 1, 2, 3, 4])
+    assert set(md) == {0, 1}
+    # chunk 0 missing -> k chunks chosen
+    md = ec.minimum_to_decode([0], [1, 2, 3, 4])
+    assert len(md) == 3
+    assert all(v == [(0, 1)] for v in md.values())
+    with pytest.raises(ErasureCodeError):
+        ec.minimum_to_decode([0], [1, 2])
+
+
+def test_minimum_to_decode_with_cost(registry):
+    ec = registry.factory("jerasure", {"k": "2", "m": "2"})
+    got = ec.minimum_to_decode_with_cost([0], {1: 10, 2: 1, 3: 5})
+    assert got == [2, 3]  # cheapest two
+
+
+def test_cross_plugin_interop_jerasure_tpu(registry):
+    """tpu and jerasure produce identical chunk bytes for the same technique."""
+    payload = bytes(np.random.default_rng(6).integers(0, 256, 64 * 1024, dtype=np.uint8))
+    j = registry.factory("jerasure", {"k": "8", "m": "3", "technique": "reed_sol_van"})
+    t = registry.factory("tpu", {"k": "8", "m": "3", "technique": "reed_sol_van"})
+    ids = list(range(11))
+    ej = j.encode(ids, payload)
+    et = t.encode(ids, payload)
+    assert ej == et
+    # tpu decodes chunks encoded by jerasure with erasures
+    survivors = {i: b for i, b in ej.items() if i not in (0, 4, 9)}
+    assert t.decode_concat(survivors, len(ej[0]))[: len(payload)] == payload
+
+
+def test_tpu_batched_stripes_match_scalar(registry):
+    ec = registry.factory("tpu", {"k": "4", "m": "2"})
+    rng = np.random.default_rng(7)
+    batch = rng.integers(0, 256, (5, 4, 2048), dtype=np.uint8).astype(np.uint8)
+    parity = ec.encode_stripes(batch)
+    assert parity.shape == (5, 2, 2048)
+    for s in range(5):
+        chunks = {i: batch[s, i].copy() for i in range(4)}
+        chunks.update({4 + i: np.zeros(2048, np.uint8) for i in range(2)})
+        ec.encode_chunks(chunks)
+        for i in range(2):
+            assert np.array_equal(parity[s, i], chunks[4 + i])
+    # batched decode: lose chunks 1 and 4 in every stripe
+    full = np.concatenate([batch, parity], axis=1)  # (5, 6, S)
+    avail = (0, 2, 3, 5)
+    rec = ec.decode_stripes(avail, (1, 4), full[:, list(avail), :])
+    assert np.array_equal(rec[:, 0], full[:, 1])
+    assert np.array_equal(rec[:, 1], full[:, 4])
+
+
+def test_isa_defaults(registry):
+    ec = registry.factory("isa", {})
+    assert ec.get_data_chunk_count() == 7
+    assert ec.get_coding_chunk_count() == 3
