@@ -160,35 +160,60 @@ def mat_invert(M: np.ndarray) -> np.ndarray:
 def reed_sol_van_matrix(k: int, m: int) -> np.ndarray:
     """Systematic Vandermonde RS coding matrix (m, k), jerasure reed_sol_van.
 
-    Extended Vandermonde vdm[i][j] = i^j for i in [0,k+m), then elementary
-    column operations make the top k rows the identity; the bottom m rows are
-    the coding matrix (Plank's corrected tutorial algorithm, as wrapped by
-    reference src/erasure-code/jerasure/ErasureCodeJerasure.cc:162).
+    Byte-compatible with jerasure's reed_sol_vandermonde_coding_matrix (the
+    published Plank algorithm wrapped by reference
+    src/erasure-code/jerasure/ErasureCodeJerasure.cc:162): build the
+    *extended* Vandermonde matrix — first row e_0, last row e_{k-1}, middle
+    row i = [1, i, i^2, ...] — then systematize the top k rows to the
+    identity with row swaps + elementary column operations, and finally
+    normalize the coding block: scale each column of the coding rows so the
+    first coding row is all ones, then scale every later coding row so its
+    first element is 1 (both scalings preserve the MDS property). The
+    all-ones first coding row is the documented jerasure property that makes
+    m=1 parity plain XOR for any k (and is what the reference ISA plugin's
+    region_xor single-erasure fast path relies on for its own Vandermonde,
+    src/erasure-code/isa/ErasureCodeIsa.cc:206). Golden values pinned in
+    tests/test_gf256.py.
     """
     if k + m > FIELD:
         raise ValueError("k+m must be <= 256 for GF(2^8)")
     rows = k + m
     vdm = np.zeros((rows, k), dtype=np.uint8)
-    for i in range(rows):
-        for j in range(k):
-            vdm[i, j] = gf_pow(i, j)
-    # column-reduce so top k x k becomes identity
-    for i in range(k):
-        if vdm[i, i] == 0:
-            for j in range(i + 1, k):
-                if vdm[i, j] != 0:
-                    vdm[:, [i, j]] = vdm[:, [j, i]]
-                    break
-            else:
-                raise np.linalg.LinAlgError("vandermonde systematization failed")
+    vdm[0, 0] = 1
+    vdm[rows - 1, k - 1] = 1
+    q = 1
+    for i in range(1, rows - 1):
+        vdm[i, 0] = 1
+        for j in range(1, k):
+            vdm[i, j] = gf_mul(int(vdm[i, j - 1]), q)
+        q += 1
+    # systematize: make row i equal e_i for i in 1..k-1 (row 0 already is e_0)
+    for i in range(1, k):
+        # find a row at/below i with a nonzero entry in column i, swap it up
+        j = i
+        while j < rows and vdm[j, i] == 0:
+            j += 1
+        if j >= rows:
+            raise np.linalg.LinAlgError("vandermonde systematization failed")
+        if j != i:
+            vdm[[i, j]] = vdm[[j, i]]
         piv = int(vdm[i, i])
         if piv != 1:
             vdm[:, i] = GF_MUL_TABLE[gf_inv(piv), vdm[:, i]]
-        for j in range(k):
-            if j == i or vdm[i, j] == 0:
-                continue
-            vdm[:, j] ^= GF_MUL_TABLE[int(vdm[i, j]), vdm[:, i]]
+        for c in range(k):
+            if c != i and vdm[i, c] != 0:
+                vdm[:, c] ^= GF_MUL_TABLE[int(vdm[i, c]), vdm[:, i]]
     coding = vdm[k:].copy()
+    # normalize: first coding row -> all ones (divide each coding column by
+    # its first-row element), later rows -> leading element 1
+    for j in range(k):
+        d = int(coding[0, j])
+        if d not in (0, 1):
+            coding[:, j] = GF_MUL_TABLE[gf_inv(d), coding[:, j]]
+    for i in range(1, m):
+        d = int(coding[i, 0])
+        if d not in (0, 1):
+            coding[i] = GF_MUL_TABLE[gf_inv(d), coding[i]]
     coding.setflags(write=False)
     return coding
 
@@ -229,7 +254,10 @@ def cauchy_good_matrix(k: int, m: int) -> np.ndarray:
 
     Start from cauchy_orig; divide each column j by its row-0 element so row 0
     becomes all ones; then for each subsequent row pick the element divisor
-    that minimizes the total popcount of the row's bitmatrices.
+    that minimizes the total popcount of the row's bitmatrices. Divisor
+    candidates are scanned in column order with strict-improvement comparison
+    so ties resolve deterministically, matching jerasure's
+    cauchy_good_general_coding_matrix scan order.
     """
     A = np.array(cauchy_orig_matrix(k, m), dtype=np.uint8)
     for j in range(k):
@@ -238,9 +266,11 @@ def cauchy_good_matrix(k: int, m: int) -> np.ndarray:
             A[:, j] = GF_MUL_TABLE[gf_inv(d), A[:, j]]
     for i in range(1, m):
         best_div, best_cost = 1, sum(_bitmatrix_ones(int(x)) for x in A[i])
-        for div in map(int, set(A[i])):
-            if div in (0, 1):
+        seen = {0, 1}
+        for div in map(int, A[i]):
+            if div in seen:
                 continue
+            seen.add(div)
             cand = GF_MUL_TABLE[gf_inv(div), A[i]]
             cost = sum(_bitmatrix_ones(int(x)) for x in cand)
             if cost < best_cost:

@@ -245,11 +245,14 @@ class ErasureCode(ErasureCodeInterface):
         """Fill holes then decode_chunks (ErasureCode.cc:225)."""
         arrays: dict[int, np.ndarray] = {}
         for i, buf in chunks.items():
+            # zero-copy read-only view; only the holes below get (writable)
+            # fresh buffers — avoids a full-stripe memcpy on the degraded-read
+            # hot path (the reference avoids the same via bufferlist views)
             arr = np.frombuffer(buf, dtype=np.uint8)
             if len(arr) != chunk_size:
                 raise ErasureCodeError(
                     f"chunk {i} has size {len(arr)}, expected {chunk_size}")
-            arrays[i] = arr.copy()
+            arrays[i] = arr
         if want_to_read <= set(arrays):
             return {i: arrays[i] for i in want_to_read}
         for i in range(self.get_chunk_count()):
@@ -266,7 +269,10 @@ class ErasureCode(ErasureCodeInterface):
 
     def decode_chunks(self, want_to_read: Iterable[int],
                       chunks: dict[int, np.ndarray],
-                      available: set[int] | None = None) -> None:
+                      available: set[int]) -> None:
+        """Kernel entry: reconstruct the `want_to_read` arrays in `chunks` in
+        place. `chunks` holds every chunk id with zero-filled holes for the
+        missing ones; `available` is the set of ids holding real data."""
         raise NotImplementedError
 
     def decode_concat(self, chunks: Mapping[int, bytes], chunk_size: int) -> bytes:

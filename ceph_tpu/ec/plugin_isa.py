@@ -48,13 +48,23 @@ class ErasureCodeIsa(ErasureCodeJerasure):
 class ErasureCodeIsaVandermonde(ErasureCodeIsa):
     technique = "reed_sol_van"
 
-    def _build_matrix(self) -> np.ndarray:
-        # ISA-L's raw Vandermonde is only guaranteed invertible for small m;
-        # the reference plugin documents the same caveat. Keep byte parity
-        # for the supported range, refuse beyond it.
+    def _check_technique(self) -> None:
+        # The reference rejects (err=-EINVAL) geometries where the raw ISA-L
+        # Vandermonde is not verified MDS: k<=32, m<=4, and k<=21 when m=4
+        # (src/erasure-code/isa/ErasureCodeIsa.cc:331-362). Same limits here.
+        if self.k > 32:
+            raise ErasureCodeError(
+                f"Vandermonde: k={self.k} should be less/equal than 32")
         if self.m > 4:
             raise ErasureCodeError(
-                "isa reed_sol_van supports m<=4; use technique=cauchy")
+                f"Vandermonde: m={self.m} should be less than 5 to guarantee "
+                "an MDS codec; use technique=cauchy")
+        if self.m == 4 and self.k > 21:
+            raise ErasureCodeError(
+                f"Vandermonde: k={self.k} should be less than 22 to guarantee "
+                "an MDS codec with m=4")
+
+    def _build_matrix(self) -> np.ndarray:
         return gf256.isa_rs_vandermonde_matrix(self.k, self.m)
 
 

@@ -78,9 +78,11 @@ class ErasureCodeJerasure(ErasureCode):
 
     def decode_chunks(self, want_to_read: Iterable[int],
                       chunks: dict[int, np.ndarray],
-                      available: set[int] | None = None) -> None:
-        if available is None:
-            available = set(chunks)
+                      available: set[int]) -> None:
+        # `available` is required: the kernel contract supplies `chunks` with
+        # zero-filled holes for missing ids, so deriving it as set(chunks)
+        # would make every chunk look present and silently skip
+        # reconstruction (ADVICE r1).
         want = sorted(set(want_to_read) - available)
         if not want:
             return

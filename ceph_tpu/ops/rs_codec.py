@@ -51,6 +51,15 @@ def _bucket(n: int) -> int:
     return b
 
 
+def _bucket_batch(b: int) -> int:
+    """Round a stripe-batch count up to the next power of two (min 1) so the
+    batched kernel compiles O(log B) programs instead of one per batch size."""
+    n = 1
+    while n < b:
+        n <<= 1
+    return n
+
+
 @functools.partial(jax.jit, static_argnames=("r", "k"))
 def _apply_bitmatrix_jit(B_i8: jax.Array, data: jax.Array, r: int, k: int) -> jax.Array:
     """data (k, N) uint8, B (r*8, k*8) int8 {0,1} -> (r, N) uint8."""
@@ -128,8 +137,21 @@ class MatrixCodec:
         return _apply_bitmatrix_jit(self._B, data, self.r, self.k)
 
     def apply_batch_device(self, data: jax.Array) -> jax.Array:
-        """data (batch, k, N) uint8 on device -> (batch, r, N)."""
-        return _apply_bitmatrix_batched_jit(self._B, data, self.r, self.k)
+        """data (batch, k, N) uint8 on device -> (batch, r, N).
+
+        Both the batch and lane axes are bucket-padded (batch to a power of
+        two, N to _bucket) so the expensive matmul program is compiled once
+        per bucket, not once per caller shape; the pad/slice wrappers are
+        trivial programs. Mirrors MatrixCodec.apply (ADVICE r1).
+        """
+        b, _, n = data.shape
+        bb, nb = _bucket_batch(b), _bucket(n)
+        if (bb, nb) != (b, n):
+            data = jnp.pad(data, ((0, bb - b), (0, 0), (0, nb - n)))
+        out = _apply_bitmatrix_batched_jit(self._B, data, self.r, self.k)
+        if (bb, nb) != (b, n):
+            out = out[:b, :, :n]
+        return out
 
     def apply(self, data: np.ndarray) -> np.ndarray:
         """Host-convenience path: pads, ships to device, returns numpy (r, N)."""

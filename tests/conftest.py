@@ -2,11 +2,24 @@
 
 Mirrors the reference's approach of exercising multi-daemon behavior on one
 host (qa/standalone/ceph-helpers.sh): we exercise multi-chip sharding on one
-host via XLA's virtual CPU devices. Must run before jax is imported anywhere.
+host via XLA's virtual CPU devices. Must run before jax initializes a backend.
+
+Hermeticity: the axon sitecustomize (loaded from the global PYTHONPATH) calls
+jax.config.update("jax_platforms", "axon,cpu") at interpreter start when
+PALLAS_AXON_POOL_IPS is set, which overrides the JAX_PLATFORMS env var and
+makes every jax.devices() call dial the TPU tunnel (hanging forever when the
+tunnel is wedged). Tests must be deterministic and TPU-independent, so we
+both scrub the env (for subprocesses we spawn) and force the config back to
+cpu (for this process, where sitecustomize has already run).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -113,3 +113,51 @@ def test_bitmatrix_invert():
         except np.linalg.LinAlgError:
             continue
     assert np.array_equal((X.astype(np.int32) @ Xi.astype(np.int32)) % 2, np.eye(16, dtype=np.int32))
+
+
+def test_reed_sol_van_matches_jerasure_construction():
+    """Pin the jerasure reed_sol_vandermonde_coding_matrix construction
+    (extended Vandermonde + systematization + coding-block normalization,
+    ADVICE r1 high). Two structural properties are independently documented:
+    the first coding row is all ones (m=1 parity is plain XOR for any k —
+    the property the reference ISA plugin's region_xor single-erasure fast
+    path relies on, src/erasure-code/isa/ErasureCodeIsa.cc:206), and later
+    rows lead with 1."""
+    for k in (2, 3, 4, 7, 10):
+        M = gf256.reed_sol_van_matrix(k, 1)
+        assert M.tolist() == [[1] * k]
+    for k, m in ((4, 2), (8, 3), (10, 4)):
+        M = gf256.reed_sol_van_matrix(k, m)
+        assert (M[0] == 1).all()
+        assert (M[1:, 0] == 1).all()
+    # golden bytes (regression pin for on-disk chunk stability)
+    assert gf256.reed_sol_van_matrix(4, 2).tolist() == [
+        [1, 1, 1, 1],
+        [1, 70, 143, 200],
+    ]
+    assert gf256.reed_sol_van_matrix(8, 3).tolist() == [
+        [1, 1, 1, 1, 1, 1, 1, 1],
+        [1, 55, 39, 73, 84, 181, 225, 217],
+        [1, 172, 70, 235, 143, 34, 200, 101],
+    ]
+
+
+def test_reed_sol_van_m1_is_xor():
+    """jerasure semantics: single parity is plain XOR of the data chunks."""
+    M = gf256.reed_sol_van_matrix(3, 1)
+    d = np.array([[0x5A], [0xC3], [0x11]], dtype=np.uint8)
+    assert gf256.mat_vec_apply(M, d)[0, 0] == 0x5A ^ 0xC3 ^ 0x11
+
+
+def test_cauchy_good_golden():
+    """Golden bytes for the column-order divisor scan: pins tie-resolution
+    so the matrix (and on-disk chunks) can never silently change."""
+    assert gf256.cauchy_good_matrix(4, 2).tolist() == [
+        [1, 1, 1, 1],
+        [143, 101, 1, 217],
+    ]
+    assert gf256.cauchy_good_matrix(6, 3).tolist() == [
+        [1, 1, 1, 1, 1, 1],
+        [200, 151, 172, 1, 225, 166],
+        [202, 143, 114, 101, 200, 1],
+    ]
