@@ -89,8 +89,11 @@ class ErasureCodeInterface:
         raise NotImplementedError
 
     def decode_chunks(self, want_to_read: Iterable[int],
-                      chunks: dict[int, np.ndarray]) -> None:
-        """Kernel entry: reconstruct missing arrays in place."""
+                      chunks: dict[int, np.ndarray],
+                      available: set[int]) -> None:
+        """Kernel entry: reconstruct the `want_to_read` arrays in place.
+        `chunks` holds every chunk id (zero-filled holes for missing ones);
+        `available` is the set of ids holding real data."""
         raise NotImplementedError
 
     def get_chunk_mapping(self) -> list[int]:

@@ -1,0 +1,62 @@
+"""ctypes loader for the native C++ runtime kernels (native/*.cc).
+
+Builds `libec_native.so` on first use with g++ (cached by source mtime) —
+the framework's analog of the reference's vendored SIMD libraries, but
+compiled from our own sources. Import `ec_native` for the GF(2^8) host codec
+and `crc32c` helpers; both raise NativeUnavailable cleanly if no compiler
+exists so pure-Python/JAX paths can fall back.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_SRC = os.path.join(_REPO, "native", "ec_native.cc")
+_BUILD_DIR = os.path.join(_REPO, "native", "_build")
+_SO = os.path.join(_BUILD_DIR, "libec_native.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _build() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        detail = getattr(e, "stderr", b"") or b""
+        raise NativeUnavailable(
+            f"building {_SO} failed: {e} {detail.decode(errors='replace')}") from e
+    return _SO
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_build())
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            u32p = ctypes.POINTER(ctypes.c_uint32)
+            lib.gf256_encode.argtypes = [u8p, ctypes.c_int, ctypes.c_int,
+                                         u8p, u8p, u8p, ctypes.c_size_t]
+            lib.gf256_region_xor.argtypes = [u8p, u8p, ctypes.c_size_t]
+            lib.crc32c.restype = ctypes.c_uint32
+            lib.crc32c.argtypes = [ctypes.c_uint32, u8p, ctypes.c_size_t]
+            lib.crc32c_blocks.argtypes = [u8p, ctypes.c_size_t,
+                                          ctypes.c_size_t, ctypes.c_uint32,
+                                          u32p]
+            lib.ec_native_have_avx2.restype = ctypes.c_int
+            lib.ec_native_have_sse42.restype = ctypes.c_int
+            _lib = lib
+    return _lib

@@ -41,23 +41,15 @@ def apply_matrix_np(M: np.ndarray, data: np.ndarray) -> np.ndarray:
     return gf256.mat_vec_apply(M, data)
 
 
-def _bucket(n: int) -> int:
-    """Round n up to a power-of-two multiple of the lane quantum."""
-    if n <= _LANE_QUANTUM:
-        return _LANE_QUANTUM
-    b = _LANE_QUANTUM
-    while b < n:
-        b <<= 1
-    return b
-
-
 def _bucket_batch(b: int) -> int:
     """Round a stripe-batch count up to the next power of two (min 1) so the
     batched kernel compiles O(log B) programs instead of one per batch size."""
-    n = 1
-    while n < b:
-        n <<= 1
-    return n
+    return 1 << max(0, (b - 1).bit_length())
+
+
+def _bucket(n: int) -> int:
+    """Round n up to a power-of-two multiple of the lane quantum."""
+    return max(_LANE_QUANTUM, _bucket_batch(n))
 
 
 @functools.partial(jax.jit, static_argnames=("r", "k"))
