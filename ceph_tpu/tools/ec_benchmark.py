@@ -75,6 +75,9 @@ def _make_instance(cfg: BenchConfig):
 def _erasure_patterns(cfg: BenchConfig, n_chunks: int,
                       rng: random.Random) -> Iterable[tuple[int, ...]]:
     """Patterns of chunk ids to erase for one decode iteration."""
+    if not cfg.erased and cfg.erasures > n_chunks:
+        raise ValueError(
+            f"--erasures {cfg.erasures} exceeds chunk count {n_chunks}")
     if cfg.erased:
         yield tuple(cfg.erased)
     elif cfg.erasures_generation == "exhaustive":
@@ -91,15 +94,22 @@ def _erasure_patterns(cfg: BenchConfig, n_chunks: int,
 # Scalar (plugin-contract) workloads — reference semantics
 # ---------------------------------------------------------------------------
 
+def _time_host_loop(fn, iterations: int, warmup: int) -> float:
+    """Time `iterations` synchronous calls of fn() after `warmup` untimed
+    ones (shared by every host-side bench path)."""
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        fn()
+    return max(time.perf_counter() - t0, 1e-9)
+
+
 def _bench_encode_scalar(cfg: BenchConfig, code) -> BenchResult:
     data = b"X" * cfg.size
     want = set(range(code.get_chunk_count()))
-    for _ in range(cfg.warmup):
-        code.encode(want, data)
-    t0 = time.perf_counter()
-    for _ in range(cfg.iterations):
-        code.encode(want, data)
-    dt = time.perf_counter() - t0
+    dt = _time_host_loop(lambda: code.encode(want, data),
+                         cfg.iterations, cfg.warmup)
     return BenchResult(dt, cfg.iterations * (cfg.size / 1024), cfg)
 
 
@@ -119,12 +129,7 @@ def _bench_decode_scalar(cfg: BenchConfig, code) -> BenchResult:
                 if decoded[i] != encoded[i]:
                     raise RuntimeError(f"chunk {i} decode mismatch")
 
-    for _ in range(cfg.warmup):
-        one_pass()
-    t0 = time.perf_counter()
-    for _ in range(cfg.iterations):
-        one_pass()
-    dt = time.perf_counter() - t0
+    dt = _time_host_loop(one_pass, cfg.iterations, cfg.warmup)
     return BenchResult(dt, cfg.iterations * (cfg.size / 1024), cfg)
 
 
@@ -133,7 +138,7 @@ def _bench_decode_scalar(cfg: BenchConfig, code) -> BenchResult:
 # ---------------------------------------------------------------------------
 
 def _device_timer():
-    """Returns (sync, rtt_of_sync). `sync(x)` forces execution of every
+    """Returns a `sync(x)` callable that forces execution of every
     program enqueued before it by fetching a tiny reduction of x — needed
     because through remote-TPU tunnels `block_until_ready` returns before
     execution and full D2H is orders slower than compute. The device runs
@@ -188,12 +193,8 @@ def _bench_encode_batched_host(cfg: BenchConfig, code) -> BenchResult:
     k = code.get_data_chunk_count()
     chunk = code.get_chunk_size(cfg.size)
     data = np.full((cfg.batch, k, chunk), ord("X"), dtype=np.uint8)
-    for _ in range(cfg.warmup):
-        code.encode_stripes(data)
-    t0 = time.perf_counter()
-    for _ in range(cfg.iterations):
-        code.encode_stripes(data)
-    dt = time.perf_counter() - t0
+    dt = _time_host_loop(lambda: code.encode_stripes(data),
+                         cfg.iterations, cfg.warmup)
     return BenchResult(dt, cfg.iterations * cfg.batch * (cfg.size / 1024), cfg)
 
 
@@ -233,12 +234,8 @@ def _bench_encode_baseline(cfg: BenchConfig, code) -> BenchResult:
     k = code.get_data_chunk_count()
     chunk = code.get_chunk_size(cfg.size)
     data = np.full((k, chunk), ord("X"), dtype=np.uint8)
-    for _ in range(cfg.warmup):
-        gf256.mat_vec_apply(M, data)
-    t0 = time.perf_counter()
-    for _ in range(cfg.iterations):
-        gf256.mat_vec_apply(M, data)
-    dt = time.perf_counter() - t0
+    dt = _time_host_loop(lambda: gf256.mat_vec_apply(M, data),
+                         cfg.iterations, cfg.warmup)
     return BenchResult(dt, cfg.iterations * (cfg.size / 1024), cfg)
 
 
@@ -251,12 +248,8 @@ def _bench_encode_native(cfg: BenchConfig, code) -> BenchResult:
     chunk = code.get_chunk_size(cfg.size)
     data = np.full((k, chunk), ord("X"), dtype=np.uint8)
     out = np.zeros((M.shape[0], chunk), dtype=np.uint8)
-    for _ in range(cfg.warmup):
-        ec_native.encode(M, data, out)
-    t0 = time.perf_counter()
-    for _ in range(cfg.iterations):
-        ec_native.encode(M, data, out)
-    dt = time.perf_counter() - t0
+    dt = _time_host_loop(lambda: ec_native.encode(M, data, out),
+                         cfg.iterations, cfg.warmup)
     return BenchResult(dt, cfg.iterations * (cfg.size / 1024), cfg)
 
 
@@ -279,12 +272,7 @@ def _bench_decode_baseline(cfg: BenchConfig, code, native: bool) -> BenchResult:
         fn = lambda: ec_native.encode(R, data, out)
     else:
         fn = lambda: gf256.mat_vec_apply(R, data)
-    for _ in range(cfg.warmup):
-        fn()
-    t0 = time.perf_counter()
-    for _ in range(cfg.iterations):
-        fn()
-    dt = time.perf_counter() - t0
+    dt = _time_host_loop(fn, cfg.iterations, cfg.warmup)
     return BenchResult(dt, cfg.iterations * (cfg.size / 1024), cfg)
 
 

@@ -1,0 +1,70 @@
+"""Multi-chip sharding tests on the 8-virtual-CPU-device mesh (conftest).
+
+Covers VERDICT r1 #2: sharded encode must be bit-exact vs the single-device
+codec, across mesh shapes and erasure patterns.
+"""
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ceph_tpu.ec import gf256
+from ceph_tpu.parallel import mesh as mesh_lib
+
+K, M = 8, 3
+
+
+def _mesh(stripe=None, shard_max=M):
+    return mesh_lib.make_mesh(8, stripe=stripe, shard_max=shard_max)
+
+
+def test_make_mesh_caps_shard_axis():
+    mesh = _mesh()
+    # 8 devices, m=3: shard must not exceed m (no all-padding devices)
+    assert mesh.shape["shard"] <= M
+    assert mesh.shape["stripe"] * mesh.shape["shard"] == 8
+    assert mesh.shape == {"stripe": 4, "shard": 2}
+
+
+def test_make_mesh_explicit_stripe():
+    assert _mesh(stripe=8).shape == {"stripe": 8, "shard": 1}
+    assert _mesh(stripe=2).shape == {"stripe": 2, "shard": 4}
+    with pytest.raises(ValueError):
+        _mesh(stripe=3)
+
+
+@pytest.mark.parametrize("stripe", [2, 4, 8])
+def test_sharded_encode_matches_single_device(stripe):
+    mesh = _mesh(stripe=stripe)
+    coding = gf256.reed_sol_van_matrix(K, M)
+    encode = mesh_lib.sharded_encode_fn(mesh, K, M)
+    rng = np.random.default_rng(7)
+    b = 8
+    data = rng.integers(0, 256, (b, K, 2048), dtype=np.uint8)
+    dev = jax.device_put(jnp.asarray(data),
+                         NamedSharding(mesh, P("stripe", None, None)))
+    parity, _ = jax.block_until_ready(encode(dev))
+    expect = np.stack([gf256.mat_vec_apply(coding, data[i]) for i in range(b)])
+    np.testing.assert_array_equal(np.asarray(parity), expect)
+
+
+@pytest.mark.parametrize("erased", [
+    (0,), (K + 2,), (0, 1, 2), (2, 7, 9), (K, K + 1, K + 2), (1, 5, K + 1),
+])
+def test_pipeline_step_reconstructs(erased):
+    mesh = _mesh()
+    step = mesh_lib.sharded_pipeline_step_fn(mesh, K, M, erased)
+    rng = np.random.default_rng(11)
+    data = jnp.asarray(rng.integers(0, 256, (4, K, 1024), dtype=np.uint8))
+    data = jax.device_put(data, NamedSharding(mesh, P("stripe", None, None)))
+    errs, _ = jax.block_until_ready(step(data))
+    assert int(errs) == 0
+
+
+def test_pipeline_step_rejects_too_many_erasures():
+    mesh = _mesh()
+    with pytest.raises(ValueError):
+        mesh_lib.sharded_pipeline_step_fn(mesh, K, M, (0, 1, 2, 3))
