@@ -1,0 +1,1 @@
+"""OSD data-plane components (EC stripe driver, transactions, backends)."""

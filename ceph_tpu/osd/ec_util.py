@@ -1,0 +1,303 @@
+"""Stripe layout + stripe codec driver — the ECUtil equivalent.
+
+StripeInfo reproduces the offset math of the reference's
+`ECUtil::stripe_info_t` (src/osd/ECUtil.h:27-119): an object's logical byte
+stream is striped over k data shards, stripe_width = k * chunk_size;
+logical offsets map to per-shard chunk offsets.
+
+encode/decode are the reference's `ECUtil::encode`/`decode`
+(src/osd/ECUtil.cc:21-170) — the site SURVEY §2.2 names as "the batching
+site for TPU dispatch". The reference loops stripe-by-stripe calling the
+plugin per stripe; here, when the plugin exposes the batched stripe APIs
+(`encode_stripes`/`decode_stripes`, the `tpu` plugin), ALL stripes go to
+the device in one dispatch and come back as per-shard contiguous buffers.
+Plugins without the batched API fall back to the reference's per-stripe
+loop, so any registered plugin works.
+
+HashInfo mirrors `ECUtil::HashInfo` (src/osd/ECUtil.h:141-199): cumulative
+per-shard crc32c maintained across appends, stored in object metadata and
+checked on reads/deep-scrub.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Mapping
+
+import numpy as np
+
+from ceph_tpu.ec.interface import ErasureCodeError
+
+
+class StripeInfo:
+    """Logical <-> chunk offset arithmetic (ECUtil.h:27-119).
+
+    Constructed from (k, stripe_width); stripe_width must be a multiple
+    of k and of the plugin's alignment so chunk_size divides evenly.
+    """
+
+    def __init__(self, data_chunks: int, stripe_width: int):
+        if stripe_width % data_chunks:
+            raise ValueError(
+                f"stripe_width {stripe_width} not divisible by k={data_chunks}")
+        self.stripe_width = stripe_width
+        self.chunk_size = stripe_width // data_chunks
+        self.k = data_chunks
+
+    # -- predicates --
+    def logical_offset_is_stripe_aligned(self, logical: int) -> bool:
+        return logical % self.stripe_width == 0
+
+    def offset_length_is_same_stripe(self, off: int, length: int) -> bool:
+        if length == 0:
+            return True
+        return off // self.stripe_width == (off + length - 1) // self.stripe_width
+
+    # -- logical -> chunk --
+    def logical_to_prev_chunk_offset(self, offset: int) -> int:
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def logical_to_next_chunk_offset(self, offset: int) -> int:
+        return -(-offset // self.stripe_width) * self.chunk_size
+
+    def logical_to_prev_stripe_offset(self, offset: int) -> int:
+        return offset - (offset % self.stripe_width)
+
+    def logical_to_next_stripe_offset(self, offset: int) -> int:
+        return -(-offset // self.stripe_width) * self.stripe_width
+
+    def aligned_logical_offset_to_chunk_offset(self, offset: int) -> int:
+        if offset % self.stripe_width:
+            raise ValueError(f"offset {offset} not stripe aligned")
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def aligned_chunk_offset_to_logical_offset(self, offset: int) -> int:
+        if offset % self.chunk_size:
+            raise ValueError(f"chunk offset {offset} not chunk aligned")
+        return (offset // self.chunk_size) * self.stripe_width
+
+    def chunk_aligned_offset_len_to_chunk(self, off: int, length: int) -> tuple[int, int]:
+        """(rounds offset down, length up) — ECUtil.cc:14."""
+        if (off % self.stripe_width) % self.chunk_size:
+            raise ValueError("offset residue not chunk aligned")
+        if (length % self.stripe_width) % self.chunk_size:
+            raise ValueError("length residue not chunk aligned")
+        return ((off // self.stripe_width) * self.chunk_size,
+                -(-length // self.stripe_width) * self.chunk_size)
+
+    # -- range expansion --
+    def offset_len_to_stripe_bounds(self, off: int, length: int) -> tuple[int, int]:
+        start = self.logical_to_prev_stripe_offset(off)
+        length = self.logical_to_next_stripe_offset((off - start) + length)
+        return start, length
+
+    def offset_len_to_chunk_bounds(self, off: int, length: int) -> tuple[int, int]:
+        start = off - (off % self.chunk_size)
+        tmp = (off - start) + length
+        return start, -(-tmp // self.chunk_size) * self.chunk_size
+
+    def offset_length_to_data_chunk_indices(self, off: int, length: int) -> tuple[int, int]:
+        """[first, last) global data-chunk indices touched by the range."""
+        return (off // self.chunk_size,
+                (self.chunk_size - 1 + off + length) // self.chunk_size)
+
+
+# ---------------------------------------------------------------------------
+# Stripe codec driver
+# ---------------------------------------------------------------------------
+
+def encode(sinfo: StripeInfo, ec_impl, data: bytes | np.ndarray,
+           want: Iterable[int] | None = None) -> dict[int, bytes]:
+    """Encode a stripe-aligned logical buffer into per-shard buffers.
+
+    Equivalent of ECUtil::encode (ECUtil.cc:134): input length must be a
+    multiple of stripe_width; output maps shard id -> contiguous buffer of
+    one chunk per stripe. One batched device dispatch when the plugin
+    supports it, else the reference's per-stripe loop.
+    """
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)) else np.ascontiguousarray(
+        data, dtype=np.uint8).reshape(-1)
+    if buf.size % sinfo.stripe_width:
+        raise ErasureCodeError(
+            f"input size {buf.size} not a multiple of stripe width "
+            f"{sinfo.stripe_width}")
+    k = ec_impl.get_data_chunk_count()
+    m = ec_impl.get_coding_chunk_count()
+    if k != sinfo.k:
+        raise ErasureCodeError(f"plugin k={k} != stripe k={sinfo.k}")
+    want = set(want) if want is not None else set(range(k + m))
+    n_stripes = buf.size // sinfo.stripe_width
+    if n_stripes == 0:
+        return {i: b"" for i in sorted(want)}
+
+    stripes = buf.reshape(n_stripes, k, sinfo.chunk_size)
+    if hasattr(ec_impl, "encode_stripes"):
+        parity = np.asarray(ec_impl.encode_stripes(stripes))
+        full = np.concatenate([stripes, parity], axis=1)  # (S, k+m, C)
+    else:
+        out_chunks = []
+        for s in range(n_stripes):
+            chunks = {i: stripes[s, i].copy() for i in range(k)}
+            for i in range(k, k + m):
+                chunks[i] = np.zeros(sinfo.chunk_size, dtype=np.uint8)
+            ec_impl.encode_chunks(chunks)
+            out_chunks.append(np.stack([chunks[i] for i in range(k + m)]))
+        full = np.stack(out_chunks)
+    # shard i = chunks of all stripes, contiguous (S major)
+    return {i: full[:, i, :].tobytes() for i in sorted(want)}
+
+
+def decode_concat(sinfo: StripeInfo, ec_impl,
+                  to_decode: Mapping[int, bytes]) -> bytes:
+    """Reconstruct and concatenate the data shards in rank order — the
+    ECUtil::decode concat variant (ECUtil.cc:21-59) feeding degraded reads.
+
+    `to_decode` maps shard id -> equal-length multi-chunk buffer.
+    """
+    k = ec_impl.get_data_chunk_count()
+    arrays = {i: np.frombuffer(b, dtype=np.uint8) for i, b in to_decode.items()}
+    if not arrays:
+        raise ErasureCodeError("no chunks to decode")
+    total = next(iter(arrays.values())).size
+    if total % sinfo.chunk_size:
+        raise ErasureCodeError("shard buffer not chunk aligned")
+    for i, a in arrays.items():
+        if a.size != total:
+            raise ErasureCodeError(f"shard {i} length {a.size} != {total}")
+    n_stripes = total // sinfo.chunk_size
+    if n_stripes == 0:
+        return b""
+
+    mapping = ec_impl.get_chunk_mapping()
+    want = [mapping[i] if mapping else i for i in range(k)]
+    avail_ids = sorted(arrays)
+    missing = [i for i in want if i not in arrays]
+
+    stacked = {i: arrays[i].reshape(n_stripes, sinfo.chunk_size)
+               for i in avail_ids}
+    if missing and hasattr(ec_impl, "decode_stripes"):
+        use = tuple(avail_ids[:k])
+        if len(use) < k:
+            raise ErasureCodeError(
+                f"cannot decode: {len(use)} shards available, need {k}")
+        src = np.stack([stacked[i] for i in use], axis=1)  # (S, k, C)
+        rec = np.asarray(ec_impl.decode_stripes(use, tuple(missing), src))
+        recovered = {mid: rec[:, j, :] for j, mid in enumerate(missing)}
+        out = np.empty((n_stripes, k, sinfo.chunk_size), dtype=np.uint8)
+        for rank, cid in enumerate(want):
+            out[:, rank, :] = stacked[cid] if cid in stacked else recovered[cid]
+        return out.tobytes()
+
+    # per-stripe fallback through the scalar contract (reference loop)
+    parts = []
+    for s in range(n_stripes):
+        chunks = {i: stacked[i][s].tobytes() for i in avail_ids}
+        parts.append(ec_impl.decode_concat(chunks, sinfo.chunk_size))
+    return b"".join(parts)
+
+
+def decode_shards(sinfo: StripeInfo, ec_impl, to_decode: Mapping[int, bytes],
+                  need: Iterable[int]) -> dict[int, bytes]:
+    """Reconstruct whole shards (data or parity) — the per-shard
+    ECUtil::decode variant (ECUtil.cc:61-131) used by shard recovery.
+
+    `to_decode` holds the shard buffers fetched per minimum_to_decode
+    (possibly sub-chunk fragments: each shard buffer contains
+    repair_data_per_chunk bytes per chunk); `need` lists shard ids to
+    rebuild. Returns full-size rebuilt shards.
+    """
+    need = sorted(set(need))
+    arrays = {i: np.frombuffer(b, dtype=np.uint8) for i, b in to_decode.items()}
+    if not arrays:
+        raise ErasureCodeError("no chunks to decode")
+    minimum = ec_impl.minimum_to_decode(need, set(arrays))
+    sub = ec_impl.get_sub_chunk_count()
+    subchunk_size = sinfo.chunk_size // sub
+    any_min = next(iter(minimum.values()))
+    repair_per_chunk = sum(cnt for _, cnt in any_min) * subchunk_size
+    total = next(iter(arrays.values())).size
+    if total % repair_per_chunk:
+        raise ErasureCodeError("shard buffer not aligned to repair unit")
+    n_chunks = total // repair_per_chunk
+
+    outs = {i: [] for i in need}
+    for c in range(n_chunks):
+        chunks = {i: a[c * repair_per_chunk:(c + 1) * repair_per_chunk].tobytes()
+                  for i, a in arrays.items()}
+        decoded = ec_impl.decode(need, chunks, sinfo.chunk_size)
+        for i in need:
+            if len(decoded[i]) != sinfo.chunk_size:
+                raise ErasureCodeError(
+                    f"decode returned {len(decoded[i])} bytes for shard {i}")
+            outs[i].append(decoded[i])
+    return {i: b"".join(parts) for i, parts in outs.items()}
+
+
+# ---------------------------------------------------------------------------
+# Per-shard cumulative chunk hashes
+# ---------------------------------------------------------------------------
+
+class HashInfo:
+    """Cumulative per-shard crc32c across appends (ECUtil.h:141-199).
+
+    Seeds at -1 like the reference's bufferlist crc32c; `append` must be
+    called with the shard map of every append in order, with old_size
+    equal to the pre-append per-shard size (torn-write detection).
+    """
+
+    def __init__(self, num_chunks: int = 0):
+        self.total_chunk_size = 0
+        self.cumulative_shard_hashes = [0xFFFFFFFF] * num_chunks
+        self.projected_total_chunk_size = 0
+
+    def has_chunk_hash(self) -> bool:
+        return bool(self.cumulative_shard_hashes)
+
+    def append(self, old_size: int, to_append: Mapping[int, bytes]) -> None:
+        if old_size != self.total_chunk_size:
+            raise ValueError(
+                f"append at {old_size} but shard size is {self.total_chunk_size}")
+        if not to_append:
+            return
+        sizes = {len(b) for b in to_append.values()}
+        if len(sizes) != 1:
+            raise ValueError(f"unequal shard append sizes {sizes}")
+        size = sizes.pop()
+        if self.has_chunk_hash():
+            if len(to_append) != len(self.cumulative_shard_hashes):
+                raise ValueError("append must cover every shard")
+            from ceph_tpu.native import ec_native
+            for shard, buf in to_append.items():
+                self.cumulative_shard_hashes[shard] = ec_native.crc32c(
+                    buf, self.cumulative_shard_hashes[shard])
+        self.total_chunk_size += size
+
+    def clear(self) -> None:
+        self.total_chunk_size = 0
+        self.cumulative_shard_hashes = [0xFFFFFFFF] * len(
+            self.cumulative_shard_hashes)
+
+    def get_chunk_hash(self, shard: int) -> int:
+        return self.cumulative_shard_hashes[shard]
+
+    def get_total_chunk_size(self) -> int:
+        return self.total_chunk_size
+
+    def get_total_logical_size(self, sinfo: StripeInfo) -> int:
+        return self.total_chunk_size * (sinfo.stripe_width // sinfo.chunk_size)
+
+    def set_projected_total_logical_size(self, sinfo: StripeInfo,
+                                         logical: int) -> None:
+        self.projected_total_chunk_size = \
+            sinfo.aligned_logical_offset_to_chunk_offset(logical)
+
+    def to_dict(self) -> dict:
+        return {"total_chunk_size": self.total_chunk_size,
+                "cumulative_shard_hashes": list(self.cumulative_shard_hashes)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HashInfo":
+        h = cls()
+        h.total_chunk_size = int(d["total_chunk_size"])
+        h.cumulative_shard_hashes = [int(x) for x in
+                                     d["cumulative_shard_hashes"]]
+        return h

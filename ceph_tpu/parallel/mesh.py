@@ -124,7 +124,11 @@ def sharded_pipeline_step_fn(mesh: Mesh, k: int, m: int,
     encode = sharded_encode_fn(mesh, k, m, coding)
 
     from ceph_tpu.ops import rs_codec
-    want = tuple(sorted(erased)) if erased is not None else tuple(range(m))
+    want = tuple(sorted(set(erased))) if erased is not None else tuple(range(m))
+    if erased is not None and len(want) != len(tuple(erased)):
+        raise ValueError(f"duplicate chunk ids in erased={erased}")
+    if any(not 0 <= w < k + m for w in want):
+        raise ValueError(f"erased ids {want} out of range 0..{k + m - 1}")
     if len(want) > m:
         raise ValueError(f"cannot erase {len(want)} > m={m} chunks")
     avail = tuple(i for i in range(k + m) if i not in want)[:k]

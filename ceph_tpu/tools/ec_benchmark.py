@@ -175,13 +175,26 @@ def _time_device_loop(fn, iterations: int, warmup: int) -> float:
     return max(dt - rtt, 1e-9)
 
 
-def _bench_encode_batched(cfg: BenchConfig, code) -> BenchResult:
+def _device_test_data(batch: int, k: int, chunk: int):
+    """Pseudo-random uint8 stripes generated ON DEVICE — through remote-TPU
+    tunnels H2D runs at ~5 MB/s, so benchmarks must not device_put their
+    working set."""
     import jax
+    import jax.numpy as jnp
 
+    @jax.jit
+    def gen():
+        i = jnp.arange(batch * k * chunk, dtype=jnp.uint32)
+        return ((i * jnp.uint32(2654435761)) >> 7).astype(jnp.uint8).reshape(
+            batch, k, chunk)
+
+    return gen()
+
+
+def _bench_encode_batched(cfg: BenchConfig, code) -> BenchResult:
     k = code.get_data_chunk_count()
     chunk = code.get_chunk_size(cfg.size)
-    data = np.full((cfg.batch, k, chunk), ord("X"), dtype=np.uint8)
-    dev = jax.device_put(data)
+    dev = _device_test_data(cfg.batch, k, chunk)
     dt = _time_device_loop(lambda: code.encode_stripes(dev),
                            cfg.iterations, cfg.warmup)
     return BenchResult(dt, cfg.iterations * cfg.batch * (cfg.size / 1024), cfg)
@@ -199,8 +212,6 @@ def _bench_encode_batched_host(cfg: BenchConfig, code) -> BenchResult:
 
 
 def _bench_decode_batched(cfg: BenchConfig, code) -> BenchResult:
-    import jax
-
     k = code.get_data_chunk_count()
     n = code.get_chunk_count()
     chunk = code.get_chunk_size(cfg.size)
@@ -208,8 +219,7 @@ def _bench_decode_batched(cfg: BenchConfig, code) -> BenchResult:
     pattern = next(iter(_erasure_patterns(cfg, n, rng)))
     avail = tuple(i for i in range(n) if i not in pattern)[:k]
     want = tuple(pattern)
-    data = np.full((cfg.batch, k, chunk), ord("X"), dtype=np.uint8)
-    dev = jax.device_put(data)
+    dev = _device_test_data(cfg.batch, k, chunk)
     dt = _time_device_loop(lambda: code.decode_stripes(avail, want, dev),
                            cfg.iterations, cfg.warmup)
     return BenchResult(dt, cfg.iterations * cfg.batch * (cfg.size / 1024), cfg)

@@ -1,0 +1,80 @@
+"""Checksummer — per-block checksum calculate/verify.
+
+Re-creation of the reference's `Checksummer` (src/common/Checksummer.h:74
+algorithm dispatch, :195-234 calculate/verify loops over csum_block_size
+blocks), the engine behind BlueStore's per-blob checksums
+(bluestore_blob_t::{calc,verify}_csum, src/os/bluestore/bluestore_types.cc:
+814,840). Algorithms: crc32c (native C++ kernel or TPU bitmatrix matmul for
+large batches), crc32c_8 / crc32c_16 (truncated, as in the reference's
+csum_type menu), xxhash variants deferred.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+CSUM_NONE = "none"
+CSUM_CRC32C = "crc32c"
+CSUM_CRC32C_16 = "crc32c_16"
+CSUM_CRC32C_8 = "crc32c_8"
+
+_VALUE_BITS = {CSUM_CRC32C: 32, CSUM_CRC32C_16: 16, CSUM_CRC32C_8: 8}
+
+# below this many blocks the device dispatch overhead beats the MXU win
+_DEVICE_MIN_BLOCKS = 256
+
+
+class Checksummer:
+    """calculate/verify per-block checksums for one (type, block_size)."""
+
+    def __init__(self, csum_type: str = CSUM_CRC32C,
+                 csum_block_size: int = 4096, use_device: bool | None = None):
+        if csum_type != CSUM_NONE and csum_type not in _VALUE_BITS:
+            raise ValueError(f"unknown csum type {csum_type!r}")
+        if csum_block_size & (csum_block_size - 1):
+            raise ValueError("csum_block_size must be a power of two")
+        self.csum_type = csum_type
+        self.block_size = csum_block_size
+        self.use_device = use_device
+
+    def _crc_blocks(self, arr: np.ndarray) -> np.ndarray:
+        nblocks = arr.size // self.block_size
+        on_device = (self.use_device if self.use_device is not None
+                     else nblocks >= _DEVICE_MIN_BLOCKS)
+        if on_device:
+            from ceph_tpu.ops import crc32c as crc_dev
+            out = crc_dev.get_device_crc(self.block_size)(
+                arr.reshape(nblocks, self.block_size))
+            return np.asarray(out)
+        from ceph_tpu.native import ec_native
+        return ec_native.crc32c_blocks(arr, self.block_size)
+
+    def calculate(self, data: bytes | np.ndarray) -> np.ndarray:
+        """Per-block checksums of a block-aligned buffer -> uint32 array
+        (truncated types still return uint32 with high bits zero, like the
+        reference storing into smaller csum_data slots)."""
+        if self.csum_type == CSUM_NONE:
+            return np.zeros(0, dtype=np.uint32)
+        arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
+            data, (bytes, bytearray, memoryview)) else np.ascontiguousarray(
+            data, dtype=np.uint8).reshape(-1)
+        if arr.size % self.block_size:
+            raise ValueError(
+                f"buffer size {arr.size} not a multiple of csum block "
+                f"{self.block_size}")
+        csums = self._crc_blocks(arr)
+        bits = _VALUE_BITS[self.csum_type]
+        if bits < 32:
+            csums = csums & ((1 << bits) - 1)
+        return csums
+
+    def verify(self, data: bytes | np.ndarray,
+               expected: np.ndarray) -> int:
+        """Returns -1 if all blocks match, else the byte offset of the
+        first mismatching block (reference verify returns bad_pos)."""
+        actual = self.calculate(data)
+        expected = np.asarray(expected, dtype=np.uint32)
+        if actual.size != expected.size:
+            raise ValueError(
+                f"{expected.size} expected csums for {actual.size} blocks")
+        bad = np.nonzero(actual != expected)[0]
+        return int(bad[0]) * self.block_size if bad.size else -1
