@@ -125,6 +125,9 @@ def encode(sinfo: StripeInfo, ec_impl, data: bytes | np.ndarray,
     if k != sinfo.k:
         raise ErasureCodeError(f"plugin k={k} != stripe k={sinfo.k}")
     want = set(want) if want is not None else set(range(k + m))
+    if any(not 0 <= w < k + m for w in want):
+        raise ErasureCodeError(f"want ids {sorted(want)} out of range "
+                               f"0..{k + m - 1}")
     n_stripes = buf.size // sinfo.stripe_width
     if n_stripes == 0:
         return {i: b"" for i in sorted(want)}
@@ -174,7 +177,14 @@ def decode_concat(sinfo: StripeInfo, ec_impl,
 
     stacked = {i: arrays[i].reshape(n_stripes, sinfo.chunk_size)
                for i in avail_ids}
-    if missing and hasattr(ec_impl, "decode_stripes"):
+    if not missing:
+        # healthy read: the result is just the rank-ordered interleave of
+        # the data shards — no plugin call needed
+        out = np.empty((n_stripes, k, sinfo.chunk_size), dtype=np.uint8)
+        for rank, cid in enumerate(want):
+            out[:, rank, :] = stacked[cid]
+        return out.tobytes()
+    if hasattr(ec_impl, "decode_stripes"):
         use = tuple(avail_ids[:k])
         if len(use) < k:
             raise ErasureCodeError(
@@ -263,8 +273,11 @@ class HashInfo:
             raise ValueError(f"unequal shard append sizes {sizes}")
         size = sizes.pop()
         if self.has_chunk_hash():
-            if len(to_append) != len(self.cumulative_shard_hashes):
-                raise ValueError("append must cover every shard")
+            if set(to_append) != set(range(len(self.cumulative_shard_hashes))):
+                raise ValueError(
+                    f"append must cover shards 0.."
+                    f"{len(self.cumulative_shard_hashes) - 1}, got "
+                    f"{sorted(to_append)}")
             from ceph_tpu.native import ec_native
             for shard, buf in to_append.items():
                 self.cumulative_shard_hashes[shard] = ec_native.crc32c(

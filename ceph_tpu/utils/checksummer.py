@@ -19,7 +19,9 @@ CSUM_CRC32C_8 = "crc32c_8"
 
 _VALUE_BITS = {CSUM_CRC32C: 32, CSUM_CRC32C_16: 16, CSUM_CRC32C_8: 8}
 
-# below this many blocks the device dispatch overhead beats the MXU win
+# device-auto threshold, applied only to buffers ALREADY on device: for
+# host buffers the H2D transfer dominates (remote tunnels run ~5 MB/s), so
+# host data stays on the native kernel unless the caller forces use_device
 _DEVICE_MIN_BLOCKS = 256
 
 
@@ -36,27 +38,39 @@ class Checksummer:
         self.block_size = csum_block_size
         self.use_device = use_device
 
-    def _crc_blocks(self, arr: np.ndarray) -> np.ndarray:
-        nblocks = arr.size // self.block_size
-        on_device = (self.use_device if self.use_device is not None
-                     else nblocks >= _DEVICE_MIN_BLOCKS)
+    def _crc_blocks(self, arr) -> np.ndarray:
+        import jax
+
+        size = arr.size
+        nblocks = size // self.block_size
+        if self.use_device is not None:
+            on_device = self.use_device
+        else:
+            on_device = (isinstance(arr, jax.Array)
+                         and nblocks >= _DEVICE_MIN_BLOCKS)
         if on_device:
             from ceph_tpu.ops import crc32c as crc_dev
             out = crc_dev.get_device_crc(self.block_size)(
                 arr.reshape(nblocks, self.block_size))
             return np.asarray(out)
         from ceph_tpu.native import ec_native
-        return ec_native.crc32c_blocks(arr, self.block_size)
+        return ec_native.crc32c_blocks(np.asarray(arr), self.block_size)
 
-    def calculate(self, data: bytes | np.ndarray) -> np.ndarray:
-        """Per-block checksums of a block-aligned buffer -> uint32 array
-        (truncated types still return uint32 with high bits zero, like the
-        reference storing into smaller csum_data slots)."""
+    def calculate(self, data) -> np.ndarray:
+        """Per-block checksums of a block-aligned buffer (bytes, numpy, or
+        device array) -> uint32 array (truncated types still return uint32
+        with high bits zero, like the reference storing into smaller
+        csum_data slots)."""
+        import jax
+
         if self.csum_type == CSUM_NONE:
             return np.zeros(0, dtype=np.uint32)
-        arr = np.frombuffer(data, dtype=np.uint8) if isinstance(
-            data, (bytes, bytearray, memoryview)) else np.ascontiguousarray(
-            data, dtype=np.uint8).reshape(-1)
+        if isinstance(data, jax.Array):
+            arr = data.reshape(-1)
+        elif isinstance(data, (bytes, bytearray, memoryview)):
+            arr = np.frombuffer(data, dtype=np.uint8)
+        else:
+            arr = np.ascontiguousarray(data, dtype=np.uint8).reshape(-1)
         if arr.size % self.block_size:
             raise ValueError(
                 f"buffer size {arr.size} not a multiple of csum block "
