@@ -223,18 +223,24 @@ class ErasureCode(ErasureCodeInterface):
     # -- encode/decode ------------------------------------------------------
 
     def encode_prepare(self, data: bytes) -> dict[int, np.ndarray]:
-        """Split + zero-pad input into k aligned chunks (ErasureCode.cc:170)."""
+        """Split + zero-pad input into k aligned chunks (ErasureCode.cc:170).
+
+        Data rank i lands at position chunk_mapping[i] when a mapping is
+        set (lrc's sparse layouts); all other positions are zero-initialized
+        coding chunks.
+        """
         chunk_size = self.get_chunk_size(len(data))
-        chunks: dict[int, np.ndarray] = {}
+        mapping = self.get_chunk_mapping()
+        chunks: dict[int, np.ndarray] = {
+            i: np.zeros(chunk_size, dtype=np.uint8)
+            for i in range(self.get_chunk_count())}
         for i in range(self.k):
-            chunk = np.zeros(chunk_size, dtype=np.uint8)
+            pos = mapping[i] if mapping else i
             lo = i * chunk_size
             hi = min(len(data), lo + chunk_size)
             if hi > lo:
-                chunk[: hi - lo] = np.frombuffer(data[lo:hi], dtype=np.uint8)
-            chunks[i] = chunk
-        for i in range(self.k, self.k + self.m):
-            chunks[i] = np.zeros(chunk_size, dtype=np.uint8)
+                chunks[pos][: hi - lo] = np.frombuffer(data[lo:hi],
+                                                       dtype=np.uint8)
         return chunks
 
     def encode(self, want_to_encode: Iterable[int], data: bytes) -> dict[int, bytes]:

@@ -231,14 +231,14 @@ class ErasureCodeClay(ErasureCode):
     # -- kernels ------------------------------------------------------------
 
     def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
-        C = self._grid_views(chunks, full=True)
+        C = self._grid_views(chunks)
         erased = {self._grid_id(i) for i in range(self.k, self.k + self.m)}
         self._decode_layered(erased, C)
 
     def decode_chunks(self, want_to_read: Iterable[int],
                       chunks: dict[int, np.ndarray],
                       available: set[int]) -> None:
-        C = self._grid_views(chunks, full=True)
+        C = self._grid_views(chunks)
         erased = {self._grid_id(i) for i in range(self.k + self.m)
                   if i not in available}
         if not erased:
@@ -256,8 +256,7 @@ class ErasureCodeClay(ErasureCode):
 
     # -- internals ----------------------------------------------------------
 
-    def _grid_views(self, chunks: dict[int, np.ndarray],
-                    full: bool) -> dict[int, np.ndarray]:
+    def _grid_views(self, chunks: dict[int, np.ndarray]) -> dict[int, np.ndarray]:
         """Map chunk arrays into grid-node (sub_chunk_no, sc) views; virtual
         shortening nodes get zero buffers."""
         size = chunks[0].size

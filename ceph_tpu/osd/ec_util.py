@@ -121,29 +121,32 @@ def encode(sinfo: StripeInfo, ec_impl, data: bytes | np.ndarray,
             f"input size {buf.size} not a multiple of stripe width "
             f"{sinfo.stripe_width}")
     k = ec_impl.get_data_chunk_count()
-    m = ec_impl.get_coding_chunk_count()
+    n_chunks = ec_impl.get_chunk_count()
     if k != sinfo.k:
         raise ErasureCodeError(f"plugin k={k} != stripe k={sinfo.k}")
-    want = set(want) if want is not None else set(range(k + m))
-    if any(not 0 <= w < k + m for w in want):
+    want = set(want) if want is not None else set(range(n_chunks))
+    if any(not 0 <= w < n_chunks for w in want):
         raise ErasureCodeError(f"want ids {sorted(want)} out of range "
-                               f"0..{k + m - 1}")
+                               f"0..{n_chunks - 1}")
     n_stripes = buf.size // sinfo.stripe_width
     if n_stripes == 0:
         return {i: b"" for i in sorted(want)}
 
     stripes = buf.reshape(n_stripes, k, sinfo.chunk_size)
-    if hasattr(ec_impl, "encode_stripes"):
+    mapping = ec_impl.get_chunk_mapping()
+    if hasattr(ec_impl, "encode_stripes") and not mapping:
         parity = np.asarray(ec_impl.encode_stripes(stripes))
-        full = np.concatenate([stripes, parity], axis=1)  # (S, k+m, C)
+        full = np.concatenate([stripes, parity], axis=1)  # (S, n, C)
     else:
+        data_pos = mapping if mapping else list(range(k))
         out_chunks = []
         for s in range(n_stripes):
-            chunks = {i: stripes[s, i].copy() for i in range(k)}
-            for i in range(k, k + m):
-                chunks[i] = np.zeros(sinfo.chunk_size, dtype=np.uint8)
+            chunks = {i: np.zeros(sinfo.chunk_size, dtype=np.uint8)
+                      for i in range(n_chunks)}
+            for rank, pos in enumerate(data_pos):
+                chunks[pos] = stripes[s, rank].copy()
             ec_impl.encode_chunks(chunks)
-            out_chunks.append(np.stack([chunks[i] for i in range(k + m)]))
+            out_chunks.append(np.stack([chunks[i] for i in range(n_chunks)]))
         full = np.stack(out_chunks)
     # shard i = chunks of all stripes, contiguous (S major)
     return {i: full[:, i, :].tobytes() for i in sorted(want)}
@@ -184,7 +187,7 @@ def decode_concat(sinfo: StripeInfo, ec_impl,
         for rank, cid in enumerate(want):
             out[:, rank, :] = stacked[cid]
         return out.tobytes()
-    if hasattr(ec_impl, "decode_stripes"):
+    if hasattr(ec_impl, "decode_stripes") and not mapping:
         use = tuple(avail_ids[:k])
         if len(use) < k:
             raise ErasureCodeError(

@@ -1,0 +1,179 @@
+"""LRC and SHEC plugin tests: reference-style exhaustive erasure sweeps,
+locality-aware minimum_to_decode, shingle window properties."""
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+
+def _make(name, **profile):
+    return ErasureCodePluginRegistry.instance().factory(
+        name, {k: str(v) for k, v in profile.items()})
+
+
+def _encode(code, seed=0):
+    rng = np.random.default_rng(seed)
+    k = code.get_data_chunk_count()
+    data = rng.integers(0, 256, k * 1024, dtype=np.uint8).tobytes()
+    n = code.get_chunk_count()
+    return data, code.encode(set(range(n)), data)
+
+
+# -- LRC ---------------------------------------------------------------------
+
+def test_lrc_kml_geometry():
+    code = _make("lrc", k=4, m=2, l=3)
+    # (k+m)/l = 2 groups; mapping "DD__DD__" -> 8 chunks, 4 data
+    assert code.get_chunk_count() == 8
+    assert code.get_data_chunk_count() == 4
+    assert len(code.layers) == 3  # 1 global + 2 local
+    assert code.get_chunk_mapping() == [0, 1, 4, 5]
+
+
+def test_lrc_kml_validation():
+    with pytest.raises(ErasureCodeError):
+        _make("lrc", k=4, m=2, l=5)       # (k+m) % l != 0
+    with pytest.raises(ErasureCodeError):
+        _make("lrc", k=3, m=3, l=3)       # k % groups != 0
+    with pytest.raises(ErasureCodeError):
+        _make("lrc", k=4, m=2)            # l missing
+    with pytest.raises(ErasureCodeError):
+        _make("lrc", k=4, m=2, l=3, mapping="DD__")  # kml + mapping
+
+
+def test_lrc_explicit_layers():
+    code = _make(
+        "lrc",
+        mapping="DD_",
+        layers='[["DDc", ""]]')
+    data, encoded = _encode(code, seed=1)
+    chunks = {i: b for i, b in encoded.items() if i != 1}
+    decoded = code.decode({1}, chunks, len(encoded[0]))
+    assert decoded[1] == encoded[1]
+
+
+def test_lrc_roundtrip_and_single_erasures():
+    code = _make("lrc", k=4, m=2, l=3)
+    data, encoded = _encode(code, seed=2)
+    n = code.get_chunk_count()
+    chunk_size = len(encoded[0])
+    assert code.decode_concat(encoded, chunk_size) == data
+    for lost in range(n):
+        chunks = {i: b for i, b in encoded.items() if i != lost}
+        decoded = code.decode({lost}, chunks, chunk_size)
+        assert decoded[lost] == encoded[lost], f"chunk {lost}"
+
+
+def test_lrc_local_repair_reads_fewer_chunks():
+    code = _make("lrc", k=4, m=2, l=3)
+    n = code.get_chunk_count()
+    # lose one data chunk: its local group (l=3 chunks + local parity)
+    # suffices — strictly fewer than the global k=4 reads
+    minimum = code.minimum_to_decode({0}, set(range(n)) - {0})
+    assert len(minimum) == 3
+    # the selected chunks are all in chunk 0's local group (positions 0-3)
+    assert set(minimum) <= {0, 1, 2, 3}
+
+
+def test_lrc_double_erasure_falls_back_to_global():
+    code = _make("lrc", k=4, m=2, l=3)
+    data, encoded = _encode(code, seed=3)
+    n = code.get_chunk_count()
+    chunk_size = len(encoded[0])
+    # two holes in ONE local group overwhelm the local parity; the global
+    # layer (m=2) must absorb them
+    for pattern in [(0, 1), (0, 3), (4, 5)]:
+        chunks = {i: b for i, b in encoded.items() if i not in pattern}
+        decoded = code.decode(set(pattern), chunks, chunk_size)
+        for i in pattern:
+            assert decoded[i] == encoded[i], f"{i} after {pattern}"
+
+
+def test_lrc_cascading_recovery():
+    # lose a local parity AND a data chunk of the same group: decoding must
+    # cascade (global recovers data, local layer re-derives its parity)
+    code = _make("lrc", k=4, m=2, l=3)
+    data, encoded = _encode(code, seed=4)
+    chunk_size = len(encoded[0])
+    pattern = (0, 2, 3)  # data 0, global parity 2, local parity 3
+    chunks = {i: b for i, b in encoded.items() if i not in pattern}
+    decoded = code.decode(set(pattern), chunks, chunk_size)
+    for i in pattern:
+        assert decoded[i] == encoded[i]
+
+
+def test_lrc_unrecoverable_raises():
+    code = _make("lrc", k=4, m=2, l=3)
+    data, encoded = _encode(code, seed=5)
+    chunk_size = len(encoded[0])
+    # all four data chunks gone: locals can absorb one each at most and
+    # the global layer (m=2) cannot absorb four
+    pattern = (0, 1, 4, 5)
+    chunks = {i: b for i, b in encoded.items() if i not in pattern}
+    with pytest.raises(ErasureCodeError):
+        code.decode(set(pattern), chunks, chunk_size)
+
+
+# -- SHEC --------------------------------------------------------------------
+
+def test_shec_matrix_is_shingled():
+    code = _make("shec", k=6, m=3, c=2)
+    M = code.matrix
+    assert M.shape == (3, 6)
+    # at least one parity row is a strict window (the shingle property);
+    # a full row is allowed (m1=1,c1=1 keeps a global parity)
+    widths = [np.count_nonzero(M[row]) for row in range(3)]
+    assert all(w > 0 for w in widths)
+    assert min(widths) < 6
+    # every data chunk is covered by at least c parities (durability)
+    for col in range(6):
+        assert np.count_nonzero(M[:, col]) >= 2
+
+
+def test_shec_validation():
+    with pytest.raises(ErasureCodeError):
+        _make("shec", k=4, m=5, c=2)      # m > k
+    with pytest.raises(ErasureCodeError):
+        _make("shec", k=4, m=3, c=4)      # c > m
+    with pytest.raises(ErasureCodeError):
+        _make("shec", k=13, m=4, c=3)     # k > 12
+    with pytest.raises(ErasureCodeError):
+        _make("shec", k=4, m=3)           # c missing
+
+
+@pytest.mark.parametrize("k,m,c,technique", [
+    (4, 3, 2, "multiple"), (6, 3, 2, "multiple"), (4, 3, 2, "single"),
+    (8, 4, 3, "multiple"),
+])
+def test_shec_exhaustive_recoverable_erasures(k, m, c, technique):
+    """Reference TestErasureCodeShec_all style: sweep erasure patterns up
+    to c chunks — shec guarantees recovery of any <= c erasures."""
+    code = _make("shec", k=k, m=m, c=c, technique=technique)
+    data, encoded = _encode(code, seed=k * 7 + m)
+    n = k + m
+    chunk_size = len(encoded[0])
+    for r in range(1, c + 1):
+        for pattern in itertools.combinations(range(n), r):
+            chunks = {i: b for i, b in encoded.items() if i not in pattern}
+            decoded = code.decode(set(pattern), chunks, chunk_size)
+            for i in pattern:
+                assert decoded[i] == encoded[i], f"{i} after {pattern}"
+
+
+def test_shec_minimum_reads_window_not_all():
+    code = _make("shec", k=8, m=4, c=3)
+    n = 12
+    minimum = code.minimum_to_decode({0}, set(range(n)) - {0})
+    runs = set(minimum)
+    # local window recovery: strictly fewer than k chunks read
+    assert len(runs) < 8, f"minimum {sorted(runs)} not local"
+
+
+def test_shec_decode_concat_roundtrip():
+    code = _make("shec", k=4, m=3, c=2)
+    data, encoded = _encode(code, seed=9)
+    chunks = {i: b for i, b in encoded.items() if i not in (1, 5)}
+    assert code.decode_concat(chunks, len(encoded[0])) == data
