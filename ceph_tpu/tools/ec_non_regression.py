@@ -1,0 +1,152 @@
+"""EC chunk-stability non-regression harness.
+
+Re-creation of the reference's `ceph_erasure_code_non_regression`
+(src/test/erasure-code/ceph_erasure_code_non_regression.cc) + the
+`ceph-erasure-code-corpus` workflow: `--create` encodes a fixed payload
+for a (plugin, profile) into a corpus directory; `--check` re-encodes the
+archived payload and fails if any chunk byte differs from the archived
+chunks — guarding on-disk encoding stability across versions.
+
+Corpus layout (one dir per profile):
+  <corpus>/<version>/<signature>/{payload,<chunk_id>}
+where signature = plugin + sorted profile items.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+VERSION = "v1"
+
+
+def signature(plugin: str, profile: dict) -> str:
+    items = sorted((k, v) for k, v in profile.items() if k != "plugin")
+    return plugin + "_" + "_".join(f"{k}={v}" for k, v in items)
+
+
+def _payload(size: int, seed: int = 42) -> bytes:
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+def _encode_all(plugin: str, profile: dict, payload: bytes) -> dict[int, bytes]:
+    code = ErasureCodePluginRegistry.instance().factory(plugin, profile)
+    return code.encode(set(range(code.get_chunk_count())), payload)
+
+
+def create(corpus: str, plugin: str, profile: dict, size: int) -> str:
+    import json
+
+    payload = _payload(size)
+    chunks = _encode_all(plugin, profile, payload)
+    d = os.path.join(corpus, VERSION, signature(plugin, profile))
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "profile.json"), "w") as f:
+        json.dump({"plugin": plugin, **profile}, f, sort_keys=True)
+    with open(os.path.join(d, "payload"), "wb") as f:
+        f.write(payload)
+    for cid, buf in chunks.items():
+        with open(os.path.join(d, str(cid)), "wb") as f:
+            f.write(buf)
+    return d
+
+
+def check(corpus: str, plugin: str, profile: dict) -> list[str]:
+    """Returns a list of mismatch descriptions (empty = stable)."""
+    d = os.path.join(corpus, VERSION, signature(plugin, profile))
+    if not os.path.isdir(d):
+        return [f"no archived corpus at {d}"]
+    try:
+        with open(os.path.join(d, "payload"), "rb") as f:
+            payload = f.read()
+    except OSError as e:
+        return [f"unreadable payload in {d}: {e}"]
+    chunks = _encode_all(plugin, profile, payload)
+    errors = []
+    # archived chunks the current encoder no longer produces are format
+    # breaks too (dropped/renumbered shards)
+    archived_ids = {name for name in os.listdir(d) if name.isdigit()}
+    orphans = archived_ids - {str(cid) for cid in chunks}
+    for cid in sorted(orphans, key=int):
+        errors.append(f"chunk {cid}: archived but no longer produced")
+    for cid, buf in chunks.items():
+        path = os.path.join(d, str(cid))
+        if not os.path.exists(path):
+            errors.append(f"chunk {cid}: missing from corpus")
+            continue
+        with open(path, "rb") as f:
+            archived = f.read()
+        if archived != buf:
+            first = next(i for i, (a, b) in enumerate(zip(archived, buf))
+                         if a != b) if len(archived) == len(buf) else -1
+            errors.append(
+                f"chunk {cid}: differs from archive "
+                f"(len {len(archived)} vs {len(buf)}, first diff @{first})")
+    return errors
+
+
+def check_all(corpus: str) -> list[str]:
+    """--check over every archived profile in the corpus."""
+    import json
+
+    root = os.path.join(corpus, VERSION)
+    if not os.path.isdir(root):
+        return [f"no corpus at {root}"]
+    errors = []
+    for sig in sorted(os.listdir(root)):
+        manifest = os.path.join(root, sig, "profile.json")
+        if not os.path.exists(manifest):
+            errors.append(f"{sig}: missing profile.json manifest")
+            continue
+        with open(manifest) as f:
+            profile = json.load(f)
+        plugin = profile["plugin"]
+        errors += [f"{sig}: {e}" for e in check(corpus, plugin, profile)]
+    return errors
+
+
+def main(argv=None) -> int:
+    from ceph_tpu.tools.ec_tool import parse_profile
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--corpus", default="ceph-erasure-code-corpus")
+    p.add_argument("--create", action="store_true")
+    p.add_argument("--check", action="store_true")
+    p.add_argument("--all", action="store_true",
+                   help="check every archived profile")
+    p.add_argument("--profile", help="plugin,k=v,... (as ec_tool)")
+    p.add_argument("--size", type=int, default=4096)
+    args = p.parse_args(argv)
+
+    if args.all:
+        if args.create:
+            p.error("--all only combines with --check")
+        errors = check_all(args.corpus)
+        for e in errors:
+            print(e, file=sys.stderr)
+        print("FAILED" if errors else "ok")
+        return 1 if errors else 0
+
+    if not args.profile:
+        p.error("--profile is required (or use --check --all)")
+    plugin, profile = parse_profile(args.profile)
+    if args.create:
+        d = create(args.corpus, plugin, profile, args.size)
+        print(f"created {d}")
+        return 0
+    if args.check:
+        errors = check(args.corpus, plugin, profile)
+        for e in errors:
+            print(e, file=sys.stderr)
+        print("FAILED" if errors else "ok")
+        return 1 if errors else 0
+    p.error("one of --create/--check required")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""EC CLI tool + non-regression corpus tests."""
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.tools import ec_non_regression as nr
+from ceph_tpu.tools import ec_tool
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join(REPO, "ceph-erasure-code-corpus")
+
+
+def test_parse_profile():
+    plugin, profile = ec_tool.parse_profile("jerasure,k=4,m=2")
+    assert plugin == "jerasure"
+    assert profile == {"k": "4", "m": "2", "plugin": "jerasure"}
+    with pytest.raises(ValueError):
+        ec_tool.parse_profile("jerasure,k4")
+
+
+def test_ec_tool_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    src = tmp_path / "obj.bin"
+    src.write_bytes(rng.integers(0, 256, 50000, dtype=np.uint8).tobytes())
+    rc = ec_tool.main(["encode", "jerasure,k=4,m=2", "1024", "all",
+                       str(src)])
+    assert rc == 0
+    os.unlink(f"{src}.1")
+    os.unlink(f"{src}.5")
+    chunk_files = ",".join(f"{src}.{i}" for i in (0, 2, 3, 4))
+    out = tmp_path / "out.bin"
+    rc = ec_tool.main(["decode", "jerasure,k=4,m=2", "1024",
+                       chunk_files, str(out)])
+    assert rc == 0
+    recovered = out.read_bytes()
+    original = src.read_bytes()
+    assert recovered[:len(original)] == original
+    assert not any(recovered[len(original):])
+
+
+def test_ec_tool_plugin_exists(capsys):
+    assert ec_tool.main(["test-plugin-exists", "jerasure"]) == 0
+    assert ec_tool.main(["test-plugin-exists", "nope"]) == 1
+
+
+def test_ec_tool_calc_chunk_size(capsys):
+    assert ec_tool.main(["calc-chunk-size", "jerasure,k=4,m=2",
+                         "1048576"]) == 0
+    size = int(capsys.readouterr().out.strip())
+    assert size >= 1048576 // 4 and size % 128 == 0
+
+
+def test_corpus_is_stable():
+    """The committed corpus must re-encode byte-identically — the chunk
+    stability guarantee (ceph_erasure_code_non_regression --check)."""
+    errors = nr.check_all(CORPUS)
+    assert not errors, errors
+
+
+def test_corpus_detects_change(tmp_path):
+    plugin, profile = ec_tool.parse_profile("jerasure,k=2,m=1")
+    d = nr.create(str(tmp_path), plugin, profile, 2048)
+    assert nr.check(str(tmp_path), plugin, profile) == []
+    # corrupt one archived chunk: check must flag it
+    path = os.path.join(d, "1")
+    buf = bytearray(open(path, "rb").read())
+    buf[7] ^= 0x55
+    open(path, "wb").write(bytes(buf))
+    errors = nr.check(str(tmp_path), plugin, profile)
+    assert errors and "chunk 1" in errors[0]
