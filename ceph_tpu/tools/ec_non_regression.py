@@ -26,7 +26,11 @@ VERSION = "v1"
 
 def signature(plugin: str, profile: dict) -> str:
     items = sorted((k, v) for k, v in profile.items() if k != "plugin")
-    return plugin + "_" + "_".join(f"{k}={v}" for k, v in items)
+    raw = plugin + "_" + "_".join(f"{k}={v}" for k, v in items)
+    # one corpus entry = one directory: keep path separators and other
+    # filesystem-hostile characters out of the name (values like
+    # directory=/path would otherwise nest directories check_all can't find)
+    return "".join(c if c.isalnum() or c in "=_-." else "-" for c in raw)
 
 
 def _payload(size: int, seed: int = 42) -> bytes:
@@ -66,7 +70,10 @@ def check(corpus: str, plugin: str, profile: dict) -> list[str]:
             payload = f.read()
     except OSError as e:
         return [f"unreadable payload in {d}: {e}"]
-    chunks = _encode_all(plugin, profile, payload)
+    try:
+        chunks = _encode_all(plugin, profile, payload)
+    except Exception as e:  # a broken plugin is a finding, not an abort
+        return [f"re-encode failed: {type(e).__name__}: {e}"]
     errors = []
     # archived chunks the current encoder no longer produces are format
     # breaks too (dropped/renumbered shards)
@@ -124,7 +131,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if args.all:
-        if args.create:
+        if args.create or not args.check:
             p.error("--all only combines with --check")
         errors = check_all(args.corpus)
         for e in errors:
@@ -132,20 +139,21 @@ def main(argv=None) -> int:
         print("FAILED" if errors else "ok")
         return 1 if errors else 0
 
+    if not args.create and not args.check:
+        p.error("one of --create/--check required")
     if not args.profile:
         p.error("--profile is required (or use --check --all)")
     plugin, profile = parse_profile(args.profile)
     if args.create:
         d = create(args.corpus, plugin, profile, args.size)
         print(f"created {d}")
-        return 0
+    errors = []
     if args.check:
         errors = check(args.corpus, plugin, profile)
         for e in errors:
             print(e, file=sys.stderr)
         print("FAILED" if errors else "ok")
-        return 1 if errors else 0
-    p.error("one of --create/--check required")
+    return 1 if errors else 0
 
 
 if __name__ == "__main__":

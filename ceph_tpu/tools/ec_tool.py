@@ -88,8 +88,12 @@ def cmd_decode(args) -> int:
             print(f"chunk file {path!r} has no numeric .<chunk_id> suffix",
                   file=sys.stderr)
             return 1
+        cid = int(suffix)
+        if cid in chunks:
+            print(f"duplicate chunk id {cid} from {path!r}", file=sys.stderr)
+            return 1
         with open(path, "rb") as f:
-            chunks[int(suffix)] = f.read()
+            chunks[cid] = f.read()
     data = ec_util.decode_concat(si, code, chunks)
     with open(args.out, "wb") as f:
         f.write(data)

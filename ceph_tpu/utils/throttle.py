@@ -1,0 +1,140 @@
+"""Throttle + HeartbeatMap: backpressure and stuck-thread detection.
+
+Re-creations of the reference's `Throttle` (src/common/Throttle.{h,cc}:
+blocking counted-resource budget used on every IO path) and
+`HeartbeatMap` (src/common/HeartbeatMap.{h,cc}: every worker thread
+checks in with a grace deadline; `is_healthy` flags stuck threads and a
+suicide grace escalates to process abort).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Throttle:
+    """Blocking budget of `max_count` units (bytes, ops, ...)."""
+
+    def __init__(self, name: str, max_count: int):
+        self.name = name
+        self._max = max_count
+        self._count = 0
+        self._cond = threading.Condition()
+
+    @property
+    def current(self) -> int:
+        with self._cond:
+            return self._count
+
+    @property
+    def max(self) -> int:
+        with self._cond:
+            return self._max
+
+    def reset_max(self, max_count: int) -> None:
+        with self._cond:
+            self._max = max_count
+            self._cond.notify_all()
+
+    def get(self, count: int = 1, timeout: float | None = None) -> bool:
+        """Block until `count` units fit (or timeout). Requests larger than
+        the whole budget are admitted alone, like the reference."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._fits(count):
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            self._count += count
+            return True
+
+    def take(self, count: int = 1) -> int:
+        """Unconditionally take (may exceed max) — reference Throttle::take."""
+        with self._cond:
+            self._count += count
+            return self._count
+
+    def get_or_fail(self, count: int = 1) -> bool:
+        with self._cond:
+            if not self._fits(count):
+                return False
+            self._count += count
+            return True
+
+    def put(self, count: int = 1) -> int:
+        with self._cond:
+            self._count = max(0, self._count - count)
+            self._cond.notify_all()
+            return self._count
+
+    def _fits(self, count: int) -> bool:
+        if self._max <= 0:
+            return True
+        if count >= self._max:
+            return self._count == 0
+        return self._count + count <= self._max
+
+
+class HeartbeatHandle:
+    def __init__(self, name: str, grace: float, suicide_grace: float):
+        self.name = name
+        self.grace = grace
+        self.suicide_grace = suicide_grace
+        self.deadline = 0.0
+        self.suicide_deadline = 0.0
+
+    def reset(self, now: float) -> None:
+        self.deadline = now + self.grace
+        self.suicide_deadline = now + self.suicide_grace if \
+            self.suicide_grace > 0 else 0.0
+
+
+class HeartbeatMap:
+    """Worker-thread liveness registry (HeartbeatMap.h)."""
+
+    def __init__(self, on_suicide=None):
+        self._lock = threading.Lock()
+        self._handles: dict[int, HeartbeatHandle] = {}
+        self._next = 0
+        self._on_suicide = on_suicide
+
+    def add_worker(self, name: str, grace: float,
+                   suicide_grace: float = 0.0) -> int:
+        with self._lock:
+            hid = self._next
+            self._next += 1
+            handle = HeartbeatHandle(name, grace, suicide_grace)
+            handle.reset(time.monotonic())
+            self._handles[hid] = handle
+            return hid
+
+    def remove_worker(self, hid: int) -> None:
+        with self._lock:
+            self._handles.pop(hid, None)
+
+    def touch(self, hid: int) -> None:
+        """The worker's check-in (reset_timeout)."""
+        now = time.monotonic()
+        with self._lock:
+            handle = self._handles.get(hid)
+            if handle is not None:
+                handle.reset(now)
+
+    def is_healthy(self) -> tuple[bool, list[str]]:
+        """(healthy, names of overdue workers); fires on_suicide for any
+        worker past its suicide grace."""
+        now = time.monotonic()
+        unhealthy = []
+        suicides = []
+        with self._lock:
+            for handle in self._handles.values():
+                if now > handle.deadline:
+                    unhealthy.append(handle.name)
+                if handle.suicide_deadline and now > handle.suicide_deadline:
+                    suicides.append(handle.name)
+        for name in suicides:
+            if self._on_suicide is not None:
+                self._on_suicide(name)
+        return (not unhealthy, unhealthy)

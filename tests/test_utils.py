@@ -1,0 +1,254 @@
+"""Runtime substrate tests: bufferlist, config, perf counters, dout ring,
+admin socket, throttle, heartbeat map."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.native import ec_native
+from ceph_tpu.utils.admin_socket import AdminSocket, admin_command
+from ceph_tpu.utils.buffer import BufferList, Ptr
+from ceph_tpu.utils.config import (Config, ConfigError, LEVEL_CONF,
+                                   LEVEL_MON, LEVEL_OVERRIDE, Option)
+from ceph_tpu.utils.dout import DoutLogger
+from ceph_tpu.utils.perf_counters import (PerfCounters,
+                                          PerfCountersCollection)
+from ceph_tpu.utils.throttle import HeartbeatMap, Throttle
+
+
+# -- bufferlist --------------------------------------------------------------
+
+def test_bufferlist_append_and_substr():
+    bl = BufferList(b"hello ")
+    bl.append(b"world")
+    assert len(bl) == 11
+    assert bl.to_bytes() == b"hello world"
+    sub = bl.substr(3, 5)
+    assert sub.to_bytes() == b"lo wo"
+    # zero-copy: the substr shares memory with the source segments
+    assert sub.num_segments == 2
+
+
+def test_bufferlist_zero_copy_of_arrays():
+    arr = np.arange(16, dtype=np.uint8)
+    bl = BufferList(arr)
+    arr[0] = 99  # mutation is visible: shared, not copied
+    assert bl.to_array()[0] == 99
+    assert bl.is_contiguous()
+
+
+def test_bufferlist_claim_append():
+    a = BufferList(b"aa")
+    b = BufferList(b"bb")
+    a.claim_append(b)
+    assert a.to_bytes() == b"aabb"
+    assert len(b) == 0
+
+
+def test_bufferlist_rebuild_aligned():
+    bl = BufferList(b"abc")
+    bl.append(b"defg")
+    padded = bl.rebuild_aligned(8)
+    assert padded.size == 8
+    assert bl.to_bytes() == b"abcdefg"  # logical length unchanged
+    assert bl.is_contiguous()
+
+
+def test_bufferlist_crc_cache_and_equality():
+    bl = BufferList(b"0123456789")
+    crc1 = bl.crc32c()
+    assert crc1 == ec_native.crc32c(b"0123456789")
+    assert bl.crc32c() == crc1  # cached
+    bl.append(b"x")
+    assert bl.crc32c() != crc1  # invalidated
+    assert BufferList(b"xyz").contents_equal(BufferList(b"xyz"))
+    assert not BufferList(b"xyz").contents_equal(BufferList(b"xyw"))
+
+
+# -- config ------------------------------------------------------------------
+
+def _schema():
+    return [
+        Option("osd_pool_default_size", "int", 3, minimum=1, maximum=10),
+        Option("bluestore_csum_type", "str", "crc32c",
+               enum=["none", "crc32c", "crc32c_16", "crc32c_8"]),
+        Option("osd_memory_target", "size", "4g"),
+        Option("debug_ms", "bool", False),
+        Option("heartbeat_grace", "secs", 20.0),
+    ]
+
+
+def test_config_layering():
+    cfg = Config(_schema())
+    assert cfg.get("osd_pool_default_size") == 3
+    cfg.set("osd_pool_default_size", 2, LEVEL_CONF)
+    cfg.set("osd_pool_default_size", 5, LEVEL_MON)
+    assert cfg.get("osd_pool_default_size") == 5     # mon > conf
+    cfg.set("osd_pool_default_size", 1, LEVEL_OVERRIDE)
+    assert cfg.get("osd_pool_default_size") == 1     # override wins
+    cfg.rm("osd_pool_default_size", LEVEL_OVERRIDE)
+    assert cfg.get("osd_pool_default_size") == 5
+    diff = cfg.diff()
+    assert diff["osd_pool_default_size"]["level"] == LEVEL_MON
+
+
+def test_config_validation():
+    cfg = Config(_schema())
+    assert cfg.get("osd_memory_target") == 4 << 30
+    cfg.set("osd_memory_target", "512m")
+    assert cfg.get("osd_memory_target") == 512 << 20
+    with pytest.raises(ConfigError):
+        cfg.set("osd_pool_default_size", 11)          # > max
+    with pytest.raises(ConfigError):
+        cfg.set("bluestore_csum_type", "md5")         # not in enum
+    with pytest.raises(ConfigError):
+        cfg.set("nope", 1)                            # undeclared
+    cfg.set("debug_ms", "yes")
+    assert cfg.get("debug_ms") is True
+
+
+def test_config_observers():
+    cfg = Config(_schema())
+    seen = []
+    cfg.add_observer(["heartbeat_grace"], lambda n, v: seen.append((n, v)))
+    cfg.set("heartbeat_grace", 30)
+    cfg.set("debug_ms", True)                         # not watched
+    cfg.set("heartbeat_grace", 30)                    # no change -> no fire
+    assert seen == [("heartbeat_grace", 30.0)]
+
+
+def test_config_conf_file(tmp_path):
+    conf = tmp_path / "ceph.conf"
+    conf.write_text("[global]\nosd pool default size = 2\n"
+                    "[osd]\nheartbeat grace = 45\n")
+    cfg = Config(_schema())
+    cfg.load_conf(str(conf), section="osd")
+    assert cfg.get("osd_pool_default_size") == 2
+    assert cfg.get("heartbeat_grace") == 45.0
+
+
+# -- perf counters -----------------------------------------------------------
+
+def test_perf_counters():
+    pc = PerfCounters("test_osd")
+    pc.add("ops")
+    pc.add("queue_len", "gauge")
+    pc.add("op_latency", "avg")
+    pc.add("encode_time", "time")
+    pc.add("io_sizes", "histogram")
+    pc.inc("ops", 3)
+    pc.inc("queue_len", 5)
+    pc.dec("queue_len", 2)
+    pc.avg_add("op_latency", 0.5)
+    pc.avg_add("op_latency", 1.5)
+    with pc.time("encode_time"):
+        pass
+    pc.hist_add("io_sizes", 4096)
+    d = pc.dump()
+    assert d["ops"] == 3
+    assert d["queue_len"] == 3
+    assert d["op_latency"] == {"avgcount": 2, "sum": 2.0}
+    assert d["encode_time"] >= 0
+    assert d["io_sizes"]["count"] == 1 and "2^13" in d["io_sizes"]["buckets"]
+    with pytest.raises(TypeError):
+        pc.dec("ops")
+
+
+def test_perf_collection():
+    coll = PerfCountersCollection()
+    a = coll.create("a")
+    a.add("x")
+    a.inc("x")
+    assert coll.dump()["a"]["x"] == 1
+    assert coll.schema()["a"]["x"]["type"] == "u64"
+    coll.remove("a")
+    assert coll.dump() == {}
+
+
+# -- dout --------------------------------------------------------------------
+
+def test_dout_gating_and_ring(capsys):
+    log = DoutLogger("test-daemon")
+    log.set_level("osd", 1, gather_level=5)
+    log.dout("osd", 1, "visible")
+    log.dout("osd", 4, "gathered only")
+    log.dout("osd", 9, "dropped")
+    entries = log.ring.dump(out=open(os.devnull, "w"))
+    text = "\n".join(entries)
+    assert "visible" in text and "gathered only" in text
+    assert "dropped" not in text
+
+
+# -- admin socket ------------------------------------------------------------
+
+def test_admin_socket_commands(tmp_path):
+    from ceph_tpu.utils.config import Config
+    cfg = Config(_schema())
+    sock_path = str(tmp_path / "daemon.asok")
+    asok = AdminSocket(sock_path, config=cfg)
+    pc = PerfCountersCollection.instance()
+    if pc.get("asok_test") is None:
+        counters = pc.create("asok_test")
+        counters.add("hits")
+    pc.get("asok_test").inc("hits")
+    asok.register_command("status", lambda req: {"state": "active"})
+    asok.start()
+    try:
+        assert admin_command(sock_path, "version")["result"]["version"]
+        assert admin_command(sock_path, "status")["result"]["state"] == "active"
+        perf = admin_command(sock_path, "perf dump")["result"]
+        assert perf["asok_test"]["hits"] >= 1
+        admin_command(sock_path, {"prefix": "config set",
+                                  "key": "debug_ms", "value": "true"})
+        assert admin_command(sock_path, {"prefix": "config get",
+                                         "key": "debug_ms"})["result"][
+            "debug_ms"] is True
+        assert "error" in admin_command(sock_path, "bogus")
+    finally:
+        asok.stop()
+        pc.remove("asok_test")
+
+
+# -- throttle / heartbeat ----------------------------------------------------
+
+def test_throttle_blocking_and_fail():
+    th = Throttle("bytes", 10)
+    assert th.get_or_fail(6)
+    assert not th.get_or_fail(5)
+    assert th.get_or_fail(4)
+    done = []
+
+    def waiter():
+        th.get(5, timeout=5)
+        done.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    assert not done
+    th.put(6)
+    t.join(timeout=5)
+    assert done
+    # oversized request admitted only on empty throttle
+    th.put(10)
+    assert th.get(100, timeout=0.1)
+
+
+def test_heartbeat_map():
+    suicides = []
+    hb = HeartbeatMap(on_suicide=suicides.append)
+    hid = hb.add_worker("op_tp_0", grace=0.05, suicide_grace=0.1)
+    healthy, bad = hb.is_healthy()
+    assert healthy
+    time.sleep(0.12)
+    healthy, bad = hb.is_healthy()
+    assert not healthy and bad == ["op_tp_0"]
+    assert suicides == ["op_tp_0"]
+    hb.touch(hid)
+    healthy, _ = hb.is_healthy()
+    assert healthy
+    hb.remove_worker(hid)
+    assert hb.is_healthy() == (True, [])
