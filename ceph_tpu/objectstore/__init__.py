@@ -1,0 +1,8 @@
+"""Local object stores (ObjectStore API, MemStore test double)."""
+from ceph_tpu.objectstore.types import Ghobject, CollectionId
+from ceph_tpu.objectstore.store import (ObjectStore, StoreError, Transaction,
+                                        NO_SHARD)
+from ceph_tpu.objectstore.memstore import MemStore
+
+__all__ = ["Ghobject", "CollectionId", "ObjectStore", "StoreError",
+           "Transaction", "MemStore", "NO_SHARD"]

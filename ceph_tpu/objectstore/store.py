@@ -1,0 +1,205 @@
+"""ObjectStore abstract API + Transaction.
+
+Re-creation of the reference's ObjectStore contract (src/os/ObjectStore.h,
+src/os/Transaction.h): collections of objects with byte extents, xattrs,
+and omap; mutations travel as atomic `Transaction` op batches through
+`queue_transaction`, with on_applied (readable) and on_commit (durable)
+callbacks. Backends: MemStore here; a file-backed store can implement the
+same API later.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Callable, Iterable, Mapping
+
+from ceph_tpu.objectstore.types import CollectionId, Ghobject
+
+NO_SHARD = -1
+
+
+class StoreError(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code  # ENOENT / EEXIST / ...
+
+
+class Op(enum.Enum):
+    TOUCH = "touch"
+    WRITE = "write"
+    ZERO = "zero"
+    TRUNCATE = "truncate"
+    REMOVE = "remove"
+    SETATTRS = "setattrs"
+    RMATTR = "rmattr"
+    CLONE = "clone"
+    CLONE_RANGE = "clone_range"
+    OMAP_SETKEYS = "omap_setkeys"
+    OMAP_RMKEYS = "omap_rmkeys"
+    OMAP_CLEAR = "omap_clear"
+    MKCOLL = "mkcoll"
+    RMCOLL = "rmcoll"
+    COLL_MOVE_RENAME = "coll_move_rename"
+
+
+class Transaction:
+    """Ordered op batch, applied atomically (Transaction.h)."""
+
+    def __init__(self):
+        self.ops: list[tuple] = []
+        self.on_applied: list[Callable[[], None]] = []
+        self.on_commit: list[Callable[[], None]] = []
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    # -- collection ops ------------------------------------------------------
+
+    def create_collection(self, cid: CollectionId) -> "Transaction":
+        self.ops.append((Op.MKCOLL, cid))
+        return self
+
+    def remove_collection(self, cid: CollectionId) -> "Transaction":
+        self.ops.append((Op.RMCOLL, cid))
+        return self
+
+    # -- object ops ----------------------------------------------------------
+
+    def touch(self, cid: CollectionId, oid: Ghobject) -> "Transaction":
+        self.ops.append((Op.TOUCH, cid, oid))
+        return self
+
+    def write(self, cid: CollectionId, oid: Ghobject, offset: int,
+              data: bytes) -> "Transaction":
+        self.ops.append((Op.WRITE, cid, oid, offset, bytes(data)))
+        return self
+
+    def zero(self, cid: CollectionId, oid: Ghobject, offset: int,
+             length: int) -> "Transaction":
+        self.ops.append((Op.ZERO, cid, oid, offset, length))
+        return self
+
+    def truncate(self, cid: CollectionId, oid: Ghobject,
+                 size: int) -> "Transaction":
+        self.ops.append((Op.TRUNCATE, cid, oid, size))
+        return self
+
+    def remove(self, cid: CollectionId, oid: Ghobject) -> "Transaction":
+        self.ops.append((Op.REMOVE, cid, oid))
+        return self
+
+    def setattrs(self, cid: CollectionId, oid: Ghobject,
+                 attrs: Mapping[str, bytes]) -> "Transaction":
+        self.ops.append((Op.SETATTRS, cid, oid,
+                         {k: bytes(v) for k, v in attrs.items()}))
+        return self
+
+    def setattr(self, cid: CollectionId, oid: Ghobject, name: str,
+                value: bytes) -> "Transaction":
+        return self.setattrs(cid, oid, {name: value})
+
+    def rmattr(self, cid: CollectionId, oid: Ghobject,
+               name: str) -> "Transaction":
+        self.ops.append((Op.RMATTR, cid, oid, name))
+        return self
+
+    def clone(self, cid: CollectionId, src: Ghobject,
+              dst: Ghobject) -> "Transaction":
+        self.ops.append((Op.CLONE, cid, src, dst))
+        return self
+
+    def clone_range(self, cid: CollectionId, src: Ghobject, dst: Ghobject,
+                    src_off: int, length: int, dst_off: int) -> "Transaction":
+        self.ops.append((Op.CLONE_RANGE, cid, src, dst, src_off, length,
+                         dst_off))
+        return self
+
+    def collection_move_rename(self, old_cid: CollectionId, old_oid: Ghobject,
+                               new_cid: CollectionId,
+                               new_oid: Ghobject) -> "Transaction":
+        self.ops.append((Op.COLL_MOVE_RENAME, old_cid, old_oid, new_cid,
+                         new_oid))
+        return self
+
+    # -- omap ----------------------------------------------------------------
+
+    def omap_setkeys(self, cid: CollectionId, oid: Ghobject,
+                     keys: Mapping[str, bytes]) -> "Transaction":
+        self.ops.append((Op.OMAP_SETKEYS, cid, oid,
+                         {k: bytes(v) for k, v in keys.items()}))
+        return self
+
+    def omap_rmkeys(self, cid: CollectionId, oid: Ghobject,
+                    keys: Iterable[str]) -> "Transaction":
+        self.ops.append((Op.OMAP_RMKEYS, cid, oid, list(keys)))
+        return self
+
+    def omap_clear(self, cid: CollectionId, oid: Ghobject) -> "Transaction":
+        self.ops.append((Op.OMAP_CLEAR, cid, oid))
+        return self
+
+    # -- completions ---------------------------------------------------------
+
+    def register_on_applied(self, fn: Callable[[], None]) -> None:
+        self.on_applied.append(fn)
+
+    def register_on_commit(self, fn: Callable[[], None]) -> None:
+        self.on_commit.append(fn)
+
+    def append(self, other: "Transaction") -> "Transaction":
+        self.ops.extend(other.ops)
+        self.on_applied.extend(other.on_applied)
+        self.on_commit.extend(other.on_commit)
+        return self
+
+
+class ObjectStore:
+    """Abstract store API (ObjectStore.h)."""
+
+    # lifecycle
+    def mkfs(self) -> None:
+        raise NotImplementedError
+
+    def mount(self) -> None:
+        raise NotImplementedError
+
+    def umount(self) -> None:
+        raise NotImplementedError
+
+    # transactions
+    def queue_transaction(self, txn: Transaction) -> None:
+        raise NotImplementedError
+
+    # collections
+    def list_collections(self) -> list[CollectionId]:
+        raise NotImplementedError
+
+    def collection_exists(self, cid: CollectionId) -> bool:
+        raise NotImplementedError
+
+    def collection_list(self, cid: CollectionId, start: Ghobject | None = None,
+                        max_count: int = 2 ** 31) -> list[Ghobject]:
+        raise NotImplementedError
+
+    # objects
+    def exists(self, cid: CollectionId, oid: Ghobject) -> bool:
+        raise NotImplementedError
+
+    def stat(self, cid: CollectionId, oid: Ghobject) -> dict:
+        raise NotImplementedError
+
+    def read(self, cid: CollectionId, oid: Ghobject, offset: int = 0,
+             length: int | None = None) -> bytes:
+        raise NotImplementedError
+
+    def getattr(self, cid: CollectionId, oid: Ghobject, name: str) -> bytes:
+        raise NotImplementedError
+
+    def getattrs(self, cid: CollectionId, oid: Ghobject) -> dict[str, bytes]:
+        raise NotImplementedError
+
+    def omap_get(self, cid: CollectionId, oid: Ghobject) -> dict[str, bytes]:
+        raise NotImplementedError
+
+    def omap_get_values(self, cid: CollectionId, oid: Ghobject,
+                        keys: Iterable[str]) -> dict[str, bytes]:
+        raise NotImplementedError

@@ -54,8 +54,7 @@ class AdminSocket:
         self.register_command("perf schema", lambda req: pc.schema(),
                               "dump perf counter schema")
         self.register_command("dump_recent",
-                              lambda req: get_logger().ring.dump(
-                                  out=open(os.devnull, "w")),
+                              lambda req: get_logger().ring.entries(),
                               "recent log events")
         if self.config is not None:
             self.register_command("config show",
@@ -105,8 +104,11 @@ class AdminSocket:
 
     def _serve(self) -> None:
         while self._running:
+            server = self._server
+            if server is None:
+                return
             try:
-                conn, _ = self._server.accept()
+                conn, _ = server.accept()
             except OSError:
                 return
             threading.Thread(target=self._handle, args=(conn,),

@@ -13,15 +13,19 @@ import numpy as np
 
 
 class Ptr:
-    """A window onto a shared byte buffer (buffer::ptr)."""
+    """A window onto a shared byte buffer (buffer::ptr). `owned` marks
+    memory this module allocated itself (safe to cache checksums over);
+    windows onto caller arrays are unowned — an external writer can mutate
+    them at any time."""
 
-    __slots__ = ("raw", "offset", "length")
+    __slots__ = ("raw", "offset", "length", "owned")
 
     def __init__(self, raw: np.ndarray, offset: int = 0,
-                 length: int | None = None):
+                 length: int | None = None, owned: bool = False):
         self.raw = raw
         self.offset = offset
         self.length = raw.size - offset if length is None else length
+        self.owned = owned
 
     def view(self) -> np.ndarray:
         return self.raw[self.offset:self.offset + self.length]
@@ -29,7 +33,7 @@ class Ptr:
     def substr(self, off: int, length: int) -> "Ptr":
         if off + length > self.length:
             raise ValueError("substr out of range")
-        return Ptr(self.raw, self.offset + off, length)
+        return Ptr(self.raw, self.offset + off, length, self.owned)
 
 
 class BufferList:
@@ -69,7 +73,7 @@ class BufferList:
             self._length += arr.size
         else:
             arr = np.frombuffer(bytes(data), dtype=np.uint8).copy()
-            self._ptrs.append(Ptr(arr))
+            self._ptrs.append(Ptr(arr, owned=True))
             self._length += arr.size
         self._invalidate()
         return self
@@ -91,11 +95,12 @@ class BufferList:
         if off + length > other._length:
             raise ValueError(
                 f"substr [{off},{off + length}) exceeds {other._length}")
+        source = list(other._ptrs)  # snapshot: `other` may alias self
         self._ptrs = []
         self._length = 0
         self._invalidate()
         pos = 0
-        for ptr in other._ptrs:
+        for ptr in source:
             seg_end = pos + ptr.length
             if seg_end <= off:
                 pos = seg_end
@@ -133,7 +138,7 @@ class BufferList:
         """Coalesce into one contiguous segment (buffer::list::rebuild)."""
         if len(self._ptrs) > 1:
             arr = np.concatenate([p.view() for p in self._ptrs])
-            self._ptrs = [Ptr(arr)]
+            self._ptrs = [Ptr(arr, owned=True)]
             self._invalidate()
 
     def rebuild_aligned(self, align: int) -> np.ndarray:
@@ -142,11 +147,12 @@ class BufferList:
         padded array (original length stays len(self))."""
         arr = self.to_array()
         pad = (-arr.size) % align
+        owned = pad > 0 or len(self._ptrs) != 1 or self._ptrs[0].owned
         if pad:
             arr = np.concatenate([arr, np.zeros(pad, dtype=np.uint8)])
-            self._ptrs = [Ptr(arr, 0, self._length)]
+            self._ptrs = [Ptr(arr, 0, self._length, owned=True)]
         else:
-            self._ptrs = [Ptr(arr)]
+            self._ptrs = [Ptr(arr, owned=owned)]
         self._invalidate()
         return arr
 
@@ -155,13 +161,17 @@ class BufferList:
     def crc32c(self, seed: int = 0xFFFFFFFF) -> int:
         """crc32c of the content, cached per (seed, length) until the list
         is modified (bufferlist crc caching semantics)."""
+        cacheable = all(p.owned for p in self._ptrs)
         key = (seed, self._length)
-        cached = self._crc_cache.get(key)
-        if cached is None:
-            from ceph_tpu.native import ec_native
-            cached = ec_native.crc32c(self.to_array(), seed)
-            self._crc_cache[key] = cached
-        return cached
+        if cacheable:
+            cached = self._crc_cache.get(key)
+            if cached is not None:
+                return cached
+        from ceph_tpu.native import ec_native
+        crc = ec_native.crc32c(self.to_array(), seed)
+        if cacheable:
+            self._crc_cache[key] = crc
+        return crc
 
     def contents_equal(self, other: "BufferList") -> bool:
         if self._length != other._length:

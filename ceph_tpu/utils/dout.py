@@ -44,10 +44,13 @@ class LogRing:
         with self._lock:
             self._ring.append(entry)
 
+    def entries(self) -> list[str]:
+        with self._lock:
+            return list(self._ring)
+
     def dump(self, out=None) -> list[str]:
         out = out or sys.stderr
-        with self._lock:
-            entries = list(self._ring)
+        entries = self.entries()
         print(f"--- begin dump of recent events ({len(entries)}) ---",
               file=out)
         for e in entries:
@@ -102,10 +105,13 @@ class DoutLogger:
         previous = sys.excepthook
 
         def hook(exc_type, exc, tb):
-            traceback.print_exception(exc_type, exc, tb)
-            self.dump_recent()
+            # let the previous hook print the traceback (exactly once),
+            # then dump the ring
             if previous not in (None, hook):
                 previous(exc_type, exc, tb)
+            else:
+                traceback.print_exception(exc_type, exc, tb)
+            self.dump_recent()
 
         sys.excepthook = hook
 

@@ -99,7 +99,9 @@ class PerfCounters:
 
     def hist_add(self, key: str, value: float) -> None:
         self._check(key, TYPE_HISTOGRAM)
-        bucket = max(0, min(63, int(value).bit_length())) if value >= 1 else 0
+        # bucket i counts values in [2^i, 2^(i+1)); 4096 lands in "2^12"
+        bucket = max(0, min(63, int(value).bit_length() - 1)) if value >= 1 \
+            else 0
         with self._lock:
             self._buckets[key][bucket] += 1
             self._values[key] += value

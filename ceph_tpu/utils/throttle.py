@@ -84,11 +84,13 @@ class HeartbeatHandle:
         self.suicide_grace = suicide_grace
         self.deadline = 0.0
         self.suicide_deadline = 0.0
+        self.suicide_fired = False
 
     def reset(self, now: float) -> None:
         self.deadline = now + self.grace
         self.suicide_deadline = now + self.suicide_grace if \
             self.suicide_grace > 0 else 0.0
+        self.suicide_fired = False
 
 
 class HeartbeatMap:
@@ -132,7 +134,9 @@ class HeartbeatMap:
             for handle in self._handles.values():
                 if now > handle.deadline:
                     unhealthy.append(handle.name)
-                if handle.suicide_deadline and now > handle.suicide_deadline:
+                if handle.suicide_deadline and now > handle.suicide_deadline \
+                        and not handle.suicide_fired:
+                    handle.suicide_fired = True  # escalate exactly once
                     suicides.append(handle.name)
         for name in suicides:
             if self._on_suicide is not None:

@@ -152,7 +152,7 @@ def test_perf_counters():
     assert d["queue_len"] == 3
     assert d["op_latency"] == {"avgcount": 2, "sum": 2.0}
     assert d["encode_time"] >= 0
-    assert d["io_sizes"]["count"] == 1 and "2^13" in d["io_sizes"]["buckets"]
+    assert d["io_sizes"]["count"] == 1 and "2^12" in d["io_sizes"]["buckets"]
     with pytest.raises(TypeError):
         pc.dec("ops")
 
