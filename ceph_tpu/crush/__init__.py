@@ -1,0 +1,6 @@
+"""CRUSH placement (straw2 buckets + rule engine) and OSDMap."""
+from ceph_tpu.crush.crush import CrushMap, Bucket, Rule, Step, CRUSH_NONE
+from ceph_tpu.crush.osdmap import OSDMap, Pool, PG
+
+__all__ = ["CrushMap", "Bucket", "Rule", "Step", "CRUSH_NONE",
+           "OSDMap", "Pool", "PG"]

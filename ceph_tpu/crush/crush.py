@@ -1,0 +1,243 @@
+"""CRUSH: deterministic pseudo-random placement on a weighted hierarchy.
+
+Re-creation of the reference's CRUSH core (src/crush/mapper.c): straw2
+bucket selection (`bucket_straw2_choose`, mapper.c:342 — each item draws
+ln(hash)/weight and the max wins, giving weight-proportional, minimally-
+disruptive placement) and the rule engine (`crush_do_rule`, take →
+choose/chooseleaf {firstn|indep} → emit, with collision/failure retries
+and R'-style replacement for indep). Device health enters through a
+weight vector (reweights, 0 = out) exactly like the reference's
+crush_do_rule weight argument.
+
+Deliberate divergence: the hash is a splitmix64-based mix rather than
+rjenkins1, and straw2 uses float ln rather than the fixed-point log table
+— placements are deterministic and stable across runs/platforms but not
+byte-identical to a real ceph cluster's.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Iterable
+
+CRUSH_NONE = -0x7FFFFFFF  # CRUSH_ITEM_NONE: an unfilled (hole) slot
+
+DEVICE = 0  # bucket type id 0 = device (osd)
+
+
+def _mix(*values: int) -> int:
+    """Deterministic 64-bit mix (splitmix64 finalizer over the args)."""
+    h = 0x9E3779B97F4A7C15
+    for v in values:
+        h ^= (v & 0xFFFFFFFFFFFFFFFF) + 0x9E3779B97F4A7C15 + (h << 6) + (h >> 2)
+        h &= 0xFFFFFFFFFFFFFFFF
+        h ^= h >> 30
+        h = (h * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+        h ^= h >> 27
+        h = (h * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+        h ^= h >> 31
+    return h
+
+
+def _straw2_draw(x: int, item: int, r: int, weight: float) -> float:
+    """ln(u)/w draw — the straw2 race (mapper.c:342 semantics)."""
+    if weight <= 0:
+        return -math.inf
+    u = (_mix(x, item, r) & 0xFFFFFFFFFFFF) / float(1 << 48)
+    u = max(u, 1e-18)
+    return math.log(u) / weight
+
+
+@dataclasses.dataclass
+class Bucket:
+    id: int                      # negative for buckets, >= 0 for devices
+    type: int                    # 0=device, 1=host, 2=rack, ... (type ids)
+    name: str
+    items: list[int] = dataclasses.field(default_factory=list)
+    weights: list[float] = dataclasses.field(default_factory=list)
+
+    def weight(self) -> float:
+        return sum(self.weights)
+
+
+@dataclasses.dataclass
+class Step:
+    op: str                      # take | choose | chooseleaf | emit
+    num: int = 0                 # replicas to pick (0 = pool size)
+    type: int = 0                # bucket type to descend to
+    mode: str = "firstn"         # firstn | indep
+    arg: str = ""                # take target name
+
+
+@dataclasses.dataclass
+class Rule:
+    id: int
+    name: str
+    steps: list[Step]
+
+
+class CrushMap:
+    def __init__(self):
+        self._buckets: dict[int, Bucket] = {}
+        self._names: dict[str, int] = {}
+        self._rules: dict[int, Rule] = {}
+        self._type_names: dict[int, str] = {0: "osd", 1: "host", 2: "rack",
+                                            3: "row", 10: "root"}
+        self._next_bucket_id = -1
+        self.tries = 50          # choose_total_tries
+
+    # -- building ------------------------------------------------------------
+
+    def add_bucket(self, type: int, name: str) -> int:
+        if name in self._names:
+            raise ValueError(f"bucket {name!r} exists")
+        bid = self._next_bucket_id
+        self._next_bucket_id -= 1
+        self._buckets[bid] = Bucket(bid, type, name)
+        self._names[name] = bid
+        return bid
+
+    def add_item(self, parent: int | str, item: int, weight: float,
+                 name: str | None = None) -> None:
+        """Add a device or bucket under `parent` with the given weight."""
+        bucket = self._bucket(parent)
+        if item in bucket.items:
+            raise ValueError(f"item {item} already in {bucket.name}")
+        bucket.items.append(item)
+        bucket.weights.append(weight)
+        if name is not None:
+            self._names[name] = item
+
+    def reweight_item(self, parent: int | str, item: int,
+                      weight: float) -> None:
+        bucket = self._bucket(parent)
+        idx = bucket.items.index(item)
+        bucket.weights[idx] = weight
+
+    def _bucket(self, ref: int | str) -> Bucket:
+        bid = self._names[ref] if isinstance(ref, str) else ref
+        return self._buckets[bid]
+
+    def bucket_of(self, ref: int | str) -> Bucket:
+        return self._bucket(ref)
+
+    # -- rules ---------------------------------------------------------------
+
+    def add_rule(self, rule: Rule) -> None:
+        if rule.id in self._rules:
+            raise ValueError(f"rule {rule.id} exists")
+        self._rules[rule.id] = rule
+
+    def make_simple_rule(self, rule_id: int, name: str, root: str,
+                         failure_domain_type: int,
+                         mode: str = "firstn") -> Rule:
+        """replicated/EC default rule: take root; chooseleaf n of domain;
+        emit (CrushWrapper::add_simple_rule / ErasureCode::create_rule —
+        EC uses mode='indep')."""
+        rule = Rule(rule_id, name, [
+            Step("take", arg=root),
+            Step("chooseleaf", num=0, type=failure_domain_type, mode=mode),
+            Step("emit"),
+        ])
+        self.add_rule(rule)
+        return rule
+
+    # -- mapping -------------------------------------------------------------
+
+    def _choose_one(self, bucket: Bucket, x: int, r: int,
+                    weights: dict[int, float]) -> int:
+        """straw2 winner among bucket items for replica rank r."""
+        best, best_draw = CRUSH_NONE, -math.inf
+        for item, w in zip(bucket.items, bucket.weights):
+            if item >= 0:
+                w *= weights.get(item, 1.0)  # reweight/out factor
+            draw = _straw2_draw(x, item, r, w)
+            if draw > best_draw:
+                best, best_draw = item, draw
+        return best
+
+    def _descend(self, start: int, x: int, r: int, target_type: int,
+                 weights: dict[int, float]) -> int:
+        """Walk from `start` down to an item of target_type via straw2."""
+        node = start
+        for _ in range(32):
+            if target_type == DEVICE:
+                if node >= 0:
+                    return node
+            bucket = self._buckets.get(node)
+            if bucket is None:
+                return CRUSH_NONE
+            if bucket.type == target_type:
+                return node
+            node = self._choose_one(bucket, x, r, weights)
+            if node == CRUSH_NONE:
+                return CRUSH_NONE
+            if node >= 0 and target_type != DEVICE:
+                return CRUSH_NONE  # hit a device before the target type
+        return CRUSH_NONE
+
+    def _leaf_under(self, node: int, x: int, r: int,
+                    weights: dict[int, float]) -> int:
+        return self._descend(node, x, r, DEVICE, weights)
+
+    def do_rule(self, rule_id: int, x: int, num_rep: int,
+                weights: dict[int, float] | None = None) -> list[int]:
+        """Map input x to an ordered list of devices (crush_do_rule).
+
+        firstn: failures are skipped (result may be short).
+        indep: failures leave CRUSH_NONE holes at their rank — EC shard
+        ranks are positional (mapper.c indep semantics).
+        """
+        weights = weights or {}
+        rule = self._rules[rule_id]
+        working: list[int] = []
+        out: list[int] = []
+        for step in rule.steps:
+            if step.op == "take":
+                working = [self._names[step.arg]]
+            elif step.op in ("choose", "chooseleaf"):
+                n = step.num if step.num > 0 else num_rep
+                chosen: list[int] = []
+                for parent in working:
+                    chosen.extend(self._choose_n(
+                        parent, x, n, step, weights))
+                working = chosen
+            elif step.op == "emit":
+                out.extend(working)
+                working = []
+            else:
+                raise ValueError(f"unknown step op {step.op!r}")
+        return out[:num_rep] if rule.steps[-1].op == "emit" else out
+
+    def _choose_n(self, parent: int, x: int, n: int, step: Step,
+                  weights: dict[int, float]) -> list[int]:
+        firstn = step.mode == "firstn"
+        result: list[int] = []
+        seen: set[int] = set()
+        for rank in range(n):
+            placed = CRUSH_NONE
+            for attempt in range(self.tries):
+                r = rank + attempt * n  # r' sequence: distinct draws per retry
+                node = self._descend(parent, x, r, step.type, weights)
+                if node == CRUSH_NONE:
+                    continue
+                if step.op == "chooseleaf":
+                    leaf = self._leaf_under(node, x, r, weights)
+                    if leaf == CRUSH_NONE or leaf in seen:
+                        continue
+                    if weights.get(leaf, 1.0) <= 0:
+                        continue
+                    placed = leaf
+                    break
+                if node in seen:
+                    continue
+                if node >= 0 and weights.get(node, 1.0) <= 0:
+                    continue
+                placed = node
+                break
+            if placed != CRUSH_NONE:
+                seen.add(placed)
+                result.append(placed)
+            elif not firstn:
+                result.append(CRUSH_NONE)  # indep keeps the hole at rank
+        return result

@@ -1,0 +1,185 @@
+"""OSDMap: epoch-versioned cluster map driving placement.
+
+Re-creation of the reference's OSDMap essentials (src/osd/OSDMap.{h,cc}):
+osd up/down + in/out states and reweights, pools (replicated or erasure,
+pg_num, size/min_size, crush rule, EC profile name), and the placement
+pipeline `pg_to_up_acting_osds` (:2923) = raw CRUSH mapping (:2670
+`_pg_to_raw_osds`: x = stable_mod seed, crush.do_rule with the reweight
+vector) + pg_temp overrides. Epochs advance through `Incremental` deltas
+so daemons converge on identical maps from any starting epoch.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterable
+
+from ceph_tpu.crush.crush import CRUSH_NONE, CrushMap
+
+
+def stable_mod(x: int, b: int, bmask: int) -> int:
+    """OSDMap::calc_pg_masks stable modulo: pgid -> [0, pg_num) staying
+    stable as pg_num grows through powers of two (src/osd/osd_types.h)."""
+    if (x & bmask) < b:
+        return x & bmask
+    return x & (bmask >> 1)
+
+
+def _pg_seed(pool: int, ps: int) -> int:
+    # placement seed fed to CRUSH; pool mixed in so pools diverge
+    from ceph_tpu.crush.crush import _mix
+    return _mix(0x2A, pool, ps) & 0x7FFFFFFF
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class PG:
+    pool: int
+    ps: int
+
+    def __str__(self) -> str:
+        return f"{self.pool}.{self.ps:x}"
+
+
+@dataclasses.dataclass
+class Pool:
+    id: int
+    name: str
+    type: str = "replicated"          # replicated | erasure
+    size: int = 3
+    min_size: int = 2
+    pg_num: int = 32
+    crush_rule: int = 0
+    ec_profile: str = ""
+    stripe_width: int = 0
+
+    def pg_mask(self) -> int:
+        return (1 << (self.pg_num - 1).bit_length()) - 1 if self.pg_num else 0
+
+    def raw_pg_to_pg(self, ps: int) -> int:
+        return stable_mod(ps, self.pg_num, self.pg_mask())
+
+
+@dataclasses.dataclass
+class OsdState:
+    up: bool = False
+    in_cluster: bool = True
+    weight: float = 1.0               # reweight in [0,1]
+    addr: str = ""
+
+
+class OSDMap:
+    def __init__(self, crush: CrushMap | None = None):
+        self.epoch = 0
+        self.crush = crush or CrushMap()
+        self.osds: dict[int, OsdState] = {}
+        self.pools: dict[int, Pool] = {}
+        self.pool_names: dict[str, int] = {}
+        self.pg_temp: dict[PG, list[int]] = {}
+
+    # -- membership ----------------------------------------------------------
+
+    def add_osd(self, osd: int, addr: str = "") -> None:
+        self.osds[osd] = OsdState(addr=addr)
+
+    def set_up(self, osd: int, up: bool, addr: str | None = None) -> None:
+        state = self.osds[osd]
+        state.up = up
+        if addr is not None:
+            state.addr = addr
+
+    def set_in(self, osd: int, in_cluster: bool) -> None:
+        self.osds[osd].in_cluster = in_cluster
+
+    def reweight(self, osd: int, weight: float) -> None:
+        self.osds[osd].weight = max(0.0, min(1.0, weight))
+
+    def is_up(self, osd: int) -> bool:
+        return osd in self.osds and self.osds[osd].up
+
+    def get_addr(self, osd: int) -> str:
+        return self.osds[osd].addr
+
+    # -- pools ---------------------------------------------------------------
+
+    def create_pool(self, name: str, **kwargs) -> Pool:
+        if name in self.pool_names:
+            raise ValueError(f"pool {name!r} exists")
+        pid = max(self.pools, default=0) + 1
+        pool = Pool(id=pid, name=name, **kwargs)
+        self.pools[pid] = pool
+        self.pool_names[name] = pid
+        return pool
+
+    def get_pool(self, ref: int | str) -> Pool:
+        pid = self.pool_names[ref] if isinstance(ref, str) else ref
+        return self.pools[pid]
+
+    # -- placement -----------------------------------------------------------
+
+    def object_to_pg(self, pool_ref: int | str, name: str) -> PG:
+        from ceph_tpu.crush.crush import _mix
+        pool = self.get_pool(pool_ref)
+        raw_ps = _mix(0x5F, *name.encode()) & 0x7FFFFFFF
+        return PG(pool.id, pool.raw_pg_to_pg(raw_ps))
+
+    def _weights(self) -> dict[int, float]:
+        """CRUSH weight vector: out or missing osds weigh 0."""
+        return {osd: (s.weight if s.in_cluster else 0.0)
+                for osd, s in self.osds.items()}
+
+    def pg_to_raw_osds(self, pg: PG) -> list[int]:
+        pool = self.pools[pg.pool]
+        x = _pg_seed(pg.pool, pg.ps)
+        return self.crush.do_rule(pool.crush_rule, x, pool.size,
+                                  self._weights())
+
+    def pg_to_up_acting_osds(self, pg: PG) -> tuple[list[int], list[int]]:
+        """(up, acting): raw mapping with down osds removed (holes stay for
+        EC pools), then pg_temp overrides acting (OSDMap.cc:2923)."""
+        pool = self.pools[pg.pool]
+        raw = self.pg_to_raw_osds(pg)
+        if pool.type == "erasure":
+            up = [o if o != CRUSH_NONE and self.is_up(o) else CRUSH_NONE
+                  for o in raw]
+        else:
+            up = [o for o in raw if o != CRUSH_NONE and self.is_up(o)]
+        acting = self.pg_temp.get(pg, up)
+        return up, acting
+
+    def primary(self, pg: PG) -> int:
+        _, acting = self.pg_to_up_acting_osds(pg)
+        for osd in acting:
+            if osd != CRUSH_NONE:
+                return osd
+        return CRUSH_NONE
+
+    # -- epochs --------------------------------------------------------------
+
+    def inc_epoch(self) -> int:
+        self.epoch += 1
+        return self.epoch
+
+    # -- encode/decode (wire form for map distribution) ----------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "osds": {str(o): dataclasses.asdict(s)
+                     for o, s in self.osds.items()},
+            "pools": {str(p): dataclasses.asdict(pool)
+                      for p, pool in self.pools.items()},
+            "pg_temp": {str(pg): osds for pg, osds in self.pg_temp.items()},
+        }
+
+    def dumps(self) -> bytes:
+        return json.dumps(self.to_dict(), sort_keys=True).encode()
+
+    def load_dict(self, d: dict) -> None:
+        self.epoch = d["epoch"]
+        self.osds = {int(o): OsdState(**s) for o, s in d["osds"].items()}
+        self.pools = {int(p): Pool(**pool) for p, pool in d["pools"].items()}
+        self.pool_names = {pool.name: pid for pid, pool in self.pools.items()}
+        self.pg_temp = {}
+        for key, osds in d.get("pg_temp", {}).items():
+            pool_s, ps_s = key.split(".")
+            self.pg_temp[PG(int(pool_s), int(ps_s, 16))] = osds

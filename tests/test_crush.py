@@ -1,0 +1,170 @@
+"""CRUSH + OSDMap tests: determinism, weight-proportional distribution,
+minimal disruption under weight change, indep holes for EC, OSDMap
+placement pipeline."""
+import collections
+
+import pytest
+
+from ceph_tpu.crush import CRUSH_NONE, CrushMap, OSDMap, PG, Rule, Step
+
+
+def _three_host_map(osds_per_host=4):
+    """root -> 3 hosts -> 4 osds each, weight 1 per osd."""
+    cm = CrushMap()
+    root = cm.add_bucket(10, "default")
+    osd = 0
+    for h in range(3):
+        host = cm.add_bucket(1, f"host{h}")
+        cm.add_item(root, host, float(osds_per_host))
+        for _ in range(osds_per_host):
+            cm.add_item(host, osd, 1.0, name=f"osd.{osd}")
+            osd += 1
+    return cm, osd
+
+
+def test_do_rule_deterministic_and_distinct():
+    cm, n = _three_host_map()
+    cm.make_simple_rule(0, "replicated", "default", failure_domain_type=1)
+    for x in range(50):
+        a = cm.do_rule(0, x, 3)
+        b = cm.do_rule(0, x, 3)
+        assert a == b                      # deterministic
+        assert len(a) == 3
+        assert len(set(a)) == 3            # distinct osds
+        hosts = {o // 4 for o in a}
+        assert len(hosts) == 3             # one per failure domain
+
+
+def test_distribution_roughly_weight_proportional():
+    cm, n = _three_host_map()
+    cm.make_simple_rule(0, "r", "default", failure_domain_type=0)
+    counts = collections.Counter()
+    for x in range(3000):
+        for o in cm.do_rule(0, x, 1):
+            counts[o] += 1
+    expect = 3000 / n
+    for o in range(n):
+        assert 0.6 * expect < counts[o] < 1.4 * expect, (o, counts[o])
+
+
+def test_weight_change_moves_minimal_data():
+    cm, n = _three_host_map()
+    cm.make_simple_rule(0, "r", "default", failure_domain_type=0)
+    before = {x: cm.do_rule(0, x, 1)[0] for x in range(2000)}
+    # halve one osd's weight: only placements on that osd may move
+    cm.reweight_item("host0", 0, 0.5)
+    after = {x: cm.do_rule(0, x, 1)[0] for x in range(2000)}
+    moved = [x for x in before if before[x] != after[x]]
+    assert all(before[x] == 0 for x in moved), "non-osd.0 placements moved"
+    # roughly half of osd.0's share moved away
+    share = sum(1 for v in before.values() if v == 0)
+    assert 0.2 * share < len(moved) < 0.8 * share
+
+
+def test_indep_leaves_holes_firstn_compacts():
+    cm, n = _three_host_map()
+    cm.make_simple_rule(0, "ec", "default", failure_domain_type=1,
+                        mode="indep")
+    cm.make_simple_rule(1, "rep", "default", failure_domain_type=1)
+    weights = {o: 1.0 for o in range(n)}
+    base = cm.do_rule(0, 7, 3, weights)
+    assert CRUSH_NONE not in base
+    # kill every osd on the host serving rank 1
+    dead_host = base[1] // 4
+    for o in range(dead_host * 4, dead_host * 4 + 4):
+        weights[o] = 0.0
+    indep = cm.do_rule(0, 7, 3, weights)
+    rep = cm.do_rule(1, 7, 3, weights)
+    # indep preserves surviving ranks in place (EC shard ids positional)
+    assert indep[0] == base[0] and indep[2] == base[2]
+    assert len(rep) == 3 and CRUSH_NONE not in rep
+
+
+def test_chooseleaf_respects_out_devices():
+    cm, n = _three_host_map()
+    cm.make_simple_rule(0, "r", "default", failure_domain_type=1)
+    weights = {o: 1.0 for o in range(n)}
+    weights[5] = 0.0
+    for x in range(200):
+        assert 5 not in cm.do_rule(0, x, 3, weights)
+
+
+# -- OSDMap ------------------------------------------------------------------
+
+def _osdmap():
+    cm, n = _three_host_map()
+    cm.make_simple_rule(0, "rep", "default", failure_domain_type=1)
+    cm.make_simple_rule(1, "ec", "default", failure_domain_type=0,
+                        mode="indep")
+    om = OSDMap(cm)
+    for o in range(n):
+        om.add_osd(o, addr=f"127.0.0.1:{6800 + o}")
+        om.set_up(o, True)
+    return om, n
+
+
+def test_osdmap_pools_and_placement():
+    om, n = _osdmap()
+    pool = om.create_pool("rbd", size=3, pg_num=8, crush_rule=0)
+    pg = om.object_to_pg("rbd", "myobject")
+    assert 0 <= pg.ps < 8
+    up, acting = om.pg_to_up_acting_osds(pg)
+    assert up == acting and len(up) == 3
+    assert om.primary(pg) == up[0]
+    # same object, same pg, stable
+    assert om.object_to_pg("rbd", "myobject") == pg
+
+
+def test_osdmap_ec_holes_and_pg_temp():
+    om, n = _osdmap()
+    pool = om.create_pool("ecpool", type="erasure", size=6, min_size=4,
+                          pg_num=16, crush_rule=1, ec_profile="k4m2")
+    pg = om.object_to_pg("ecpool", "x")
+    up, _ = om.pg_to_up_acting_osds(pg)
+    assert len(up) == 6
+    victim = up[2]
+    om.set_up(victim, False)
+    up2, _ = om.pg_to_up_acting_osds(pg)
+    assert up2[2] == CRUSH_NONE              # EC keeps positional hole
+    assert [o for i, o in enumerate(up2) if i != 2] == \
+        [o for i, o in enumerate(up) if i != 2]
+    om.pg_temp[pg] = [up[0], up[1], 99, up[3], up[4], up[5]]
+    _, acting = om.pg_to_up_acting_osds(pg)
+    assert acting[2] == 99                   # pg_temp override
+
+
+def test_osdmap_out_osd_remapped():
+    om, n = _osdmap()
+    om.create_pool("p", size=3, pg_num=8, crush_rule=0)
+    pg = PG(1, 3)
+    up, _ = om.pg_to_up_acting_osds(pg)
+    om.set_in(up[0], False)   # mark out: CRUSH must re-place, not just skip
+    up2, _ = om.pg_to_up_acting_osds(pg)
+    assert up[0] not in up2
+    assert len(up2) == 3
+
+
+def test_osdmap_roundtrip_wire():
+    om, n = _osdmap()
+    om.create_pool("p", size=3, pg_num=8)
+    om.inc_epoch()
+    om.pg_temp[PG(1, 2)] = [1, 2, 3]
+    import json
+    om2 = OSDMap(om.crush)
+    om2.load_dict(json.loads(om.dumps()))
+    assert om2.epoch == om.epoch
+    assert om2.get_pool("p").pg_num == 8
+    assert om2.pg_temp[PG(1, 2)] == [1, 2, 3]
+    assert om2.osds[0].addr == "127.0.0.1:6800"
+
+
+def test_stable_mod_growth():
+    from ceph_tpu.crush.osdmap import stable_mod
+    # growing pg_num 8 -> 12 must keep pgs < 8 stable where possible
+    for x in range(64):
+        a = stable_mod(x, 8, 7)
+        assert 0 <= a < 8
+        b = stable_mod(x, 12, 15)
+        assert 0 <= b < 12
+        if (x & 15) < 12 and (x & 15) < 8:
+            assert a == (x & 7)
