@@ -85,6 +85,13 @@ class CrushMap:
                                             3: "row", 10: "root"}
         self._next_bucket_id = -1
         self.tries = 50          # choose_total_tries
+        # firstn only: when live failure domains are exhausted, place the
+        # remaining replicas on already-used domains (never reusing a
+        # device) instead of returning a short result like mapper.c does.
+        # Keeps replica count at the cost of domain separation in the
+        # degraded case; set False for strict reference semantics, where a
+        # short result is what signals degraded placement to the caller.
+        self.relax_firstn_on_exhaustion = True
 
     # -- building ------------------------------------------------------------
 
@@ -211,33 +218,144 @@ class CrushMap:
 
     def _choose_n(self, parent: int, x: int, n: int, step: Step,
                   weights: dict[int, float]) -> list[int]:
-        firstn = step.mode == "firstn"
-        result: list[int] = []
-        seen: set[int] = set()
-        for rank in range(n):
-            placed = CRUSH_NONE
-            for attempt in range(self.tries):
-                r = rank + attempt * n  # r' sequence: distinct draws per retry
-                node = self._descend(parent, x, r, step.type, weights)
-                if node == CRUSH_NONE:
-                    continue
-                if step.op == "chooseleaf":
-                    leaf = self._leaf_under(node, x, r, weights)
-                    if leaf == CRUSH_NONE or leaf in seen:
-                        continue
-                    if weights.get(leaf, 1.0) <= 0:
-                        continue
-                    placed = leaf
+        """Pick n items of step.type under parent (crush_choose_{firstn,indep}).
+
+        Two-phase design (deliberate divergence from mapper.c that makes
+        indep's positional stability a *guarantee* rather than best-effort):
+
+        Phase A assigns each rank a failure-domain bucket using draws that
+        do not look at leaf liveness (bucket straw2 weights are static, and
+        `_choose_one` only applies the live-weight vector to devices), with
+        domain-level collision checks — mapper.c rejects `out[i] == item`
+        at the bucket level too, which is what keeps two replicas off one
+        host.  Because these draws ignore device deaths, the assignment is
+        bit-identical between a healthy and a degraded run.
+
+        Phase B picks a live leaf under each assigned domain.  A domain
+        whose leaves are all dead leaves its rank unfilled — without
+        disturbing any other rank, since assignments were already fixed.
+
+        Phase C (repair) retries unfilled ranks attempt-major over domains
+        nobody claimed.  In mapper.c the retrying rank re-draws from the
+        full pool and can steal a domain that a later surviving rank would
+        have kept (observable rank churn under host death); here survivors
+        are immovable by construction.
+
+        firstn additionally relaxes domain distinctness once domains are
+        exhausted (phase D) so the replica count is met — the reference
+        instead returns a short result; we prefer keeping redundancy and
+        document the divergence.  indep never relaxes: failed ranks keep
+        their CRUSH_NONE hole so EC shard ids stay positional.
+        """
+        indep = step.mode == "indep"
+        domains = [CRUSH_NONE] * n   # assigned failure-domain node per rank
+        leaves = [CRUSH_NONE] * n
+        claimed: set[int] = set()
+        used_leaves: set[int] = set()
+
+        def draw_domain(rank: int, t: int, allow_claimed: bool = False) -> int:
+            node = self._descend(parent, x, rank + t * n, step.type, weights)
+            if node == CRUSH_NONE or (node in claimed and not allow_claimed):
+                return CRUSH_NONE
+            if node >= 0 and weights.get(node, 1.0) <= 0:
+                return CRUSH_NONE
+            return node
+
+        def pick_leaf(rank: int, node: int) -> int:
+            if node >= 0:   # domain is a device (choose/chooseleaf type 0)
+                return node if node not in used_leaves else CRUSH_NONE
+            if step.op == "choose":
+                # intermediate bucket: the result IS the bucket; later rule
+                # steps descend further (crush_choose without recurse_to_leaf)
+                return node if node not in used_leaves else CRUSH_NONE
+            for t in range(self.tries):
+                leaf = self._leaf_under(node, x, rank + t * n, weights)
+                if (leaf != CRUSH_NONE and leaf not in used_leaves
+                        and weights.get(leaf, 1.0) > 0):
+                    return leaf
+            return CRUSH_NONE
+
+        # Phase A: domain assignment. indep is attempt-major (a rank that
+        # can place at pass t does so before any rank's pass-t+1 retry);
+        # firstn is rank-major like crush_choose_firstn.
+        if indep:
+            for t in range(self.tries):
+                unfilled = [i for i in range(n) if domains[i] == CRUSH_NONE]
+                if not unfilled:
                     break
-                if node in seen:
+                for rank in unfilled:
+                    node = draw_domain(rank, t)
+                    if node != CRUSH_NONE:
+                        domains[rank] = node
+                        claimed.add(node)
+        else:
+            for rank in range(n):
+                for t in range(self.tries):
+                    node = draw_domain(rank, t)
+                    if node != CRUSH_NONE:
+                        domains[rank] = node
+                        claimed.add(node)
+                        break
+
+        # Phase B: leaf under each assigned domain. Dead domains stay
+        # claimed so repair draws don't waste tries re-visiting them.
+        for rank in range(n):
+            if domains[rank] == CRUSH_NONE:
+                continue
+            leaf = pick_leaf(rank, domains[rank])
+            if leaf != CRUSH_NONE:
+                leaves[rank] = leaf
+                used_leaves.add(leaf)
+
+        def repair_pass(t_offset: int, allow_claimed: bool) -> None:
+            """Attempt-major retries for unfilled ranks."""
+            for t in range(self.tries):
+                unfilled = [i for i in range(n) if leaves[i] == CRUSH_NONE]
+                if not unfilled:
+                    return
+                for rank in unfilled:
+                    node = draw_domain(rank, t_offset + t, allow_claimed)
+                    if node == CRUSH_NONE:
+                        continue
+                    leaf = pick_leaf(rank, node)
+                    if leaf == CRUSH_NONE:
+                        continue
+                    domains[rank] = node
+                    leaves[rank] = leaf
+                    claimed.add(node)
+                    used_leaves.add(leaf)
+
+        # Phase C: repair unfilled ranks over unclaimed domains only —
+        # skipped outright when every domain under parent is claimed, so a
+        # degraded mapping doesn't burn tries on guaranteed-futile draws.
+        if any(leaf == CRUSH_NONE for leaf in leaves) and \
+                len(claimed) < self._count_domains(parent, step.type):
+            repair_pass(self.tries, allow_claimed=False)
+
+        if indep:
+            return leaves  # failed ranks keep their CRUSH_NONE hole
+
+        # Phase D (firstn only): domains exhausted — allow domain reuse but
+        # never leaf reuse, then compact.
+        if self.relax_firstn_on_exhaustion:
+            repair_pass(2 * self.tries, allow_claimed=True)
+        return [leaf for leaf in leaves if leaf != CRUSH_NONE]
+
+    def _count_domains(self, parent: int, target_type: int) -> int:
+        """Number of distinct items of target_type in the subtree of parent."""
+        count = 0
+        stack = [parent]
+        while stack:
+            node = stack.pop()
+            if target_type == DEVICE:
+                if node >= 0:
+                    count += 1
                     continue
-                if node >= 0 and weights.get(node, 1.0) <= 0:
-                    continue
-                placed = node
-                break
-            if placed != CRUSH_NONE:
-                seen.add(placed)
-                result.append(placed)
-            elif not firstn:
-                result.append(CRUSH_NONE)  # indep keeps the hole at rank
-        return result
+            bucket = self._buckets.get(node)
+            if bucket is None:
+                continue
+            if bucket.type == target_type:
+                count += 1
+                continue
+            stack.extend(bucket.items)
+        return count

@@ -80,6 +80,83 @@ def test_indep_leaves_holes_firstn_compacts():
     assert len(rep) == 3 and CRUSH_NONE not in rep
 
 
+def test_ec_pool_11_osds_5_hosts_one_host_out():
+    """EC across 11 osds on 5 hosts (3+3+2+2+1); kill one host.
+
+    Semantics of mapper.c straw2 + firstn/indep recursion: indep keeps the
+    surviving ranks in place; ranks whose domain died either move to the
+    one remaining unused host or hole out; no two live shards ever share a
+    host (failure-domain distinctness at the bucket level)."""
+    cm = CrushMap()
+    root = cm.add_bucket(10, "default")
+    osd = 0
+    layout = [3, 3, 2, 2, 1]
+    host_of = {}
+    for h, count in enumerate(layout):
+        host = cm.add_bucket(1, f"host{h}")
+        cm.add_item(root, host, float(count))
+        for _ in range(count):
+            cm.add_item(host, osd, 1.0, name=f"osd.{osd}")
+            host_of[osd] = h
+            osd += 1
+    cm.make_simple_rule(0, "ec", "default", failure_domain_type=1,
+                        mode="indep")
+    weights = {i: 1.0 for i in range(11)}
+    for x in range(40):
+        base = cm.do_rule(0, x, 4, weights)
+        live = [s for s in base if s != CRUSH_NONE]
+        assert len({host_of[s] for s in live}) == len(live)  # distinct hosts
+        # kill the host serving rank 0
+        if base[0] == CRUSH_NONE:
+            continue
+        dead = host_of[base[0]]
+        w2 = dict(weights)
+        for i in range(11):
+            if host_of[i] == dead:
+                w2[i] = 0.0
+        after = cm.do_rule(0, x, 4, w2)
+        # surviving ranks stay put
+        for i in range(1, 4):
+            if base[i] != CRUSH_NONE and host_of.get(base[i]) != dead:
+                assert after[i] == base[i], (x, base, after)
+        # nothing placed on the dead host; live shards domain-distinct
+        live2 = [s for s in after if s != CRUSH_NONE]
+        assert all(host_of[s] != dead for s in live2)
+        assert len({host_of[s] for s in live2}) == len(live2)
+
+
+def test_multi_step_rule_choose_then_chooseleaf():
+    """take root; choose 2 racks; chooseleaf 2 hosts per rack; emit —
+    `choose` steps must return buckets of the target type for later steps
+    to descend (crush_choose without recurse_to_leaf)."""
+    cm = CrushMap()
+    root = cm.add_bucket(10, "default")
+    host_of, rack_of = {}, {}
+    osd = 0
+    for r in range(3):
+        rack = cm.add_bucket(2, f"rack{r}")
+        cm.add_item(root, rack, 4.0)
+        for h in range(2):
+            host = cm.add_bucket(1, f"rack{r}-host{h}")
+            cm.add_item(rack, host, 2.0)
+            for _ in range(2):
+                cm.add_item(host, osd, 1.0, name=f"osd.{osd}")
+                host_of[osd] = (r, h)
+                rack_of[osd] = r
+                osd += 1
+    cm.add_rule(Rule(0, "two-racks", [
+        Step("take", arg="default"),
+        Step("choose", num=2, type=2, mode="firstn"),
+        Step("chooseleaf", num=2, type=1, mode="firstn"),
+        Step("emit"),
+    ]))
+    for x in range(30):
+        out = cm.do_rule(0, x, 4)
+        assert len(out) == 4 and len(set(out)) == 4
+        assert len({rack_of[o] for o in out}) == 2      # two distinct racks
+        assert len({host_of[o] for o in out}) == 4      # all distinct hosts
+
+
 def test_chooseleaf_respects_out_devices():
     cm, n = _three_host_map()
     cm.make_simple_rule(0, "r", "default", failure_domain_type=1)
