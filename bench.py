@@ -2,14 +2,24 @@
 """Driver benchmark entry point: prints ONE JSON line
 `{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}`.
 
-Hang-proof by construction (VERDICT r1 #1): all JAX work happens in a child
-process (`ceph_tpu.tools.bench_driver`) under a hard wall-clock timeout, so
-a wedged backend init produces an error JSON line instead of a silent
-rc=124. The child prints its JSON on stdout; this wrapper validates it and
-re-emits exactly one line.
+Data-proof staging (VERDICT r2 #1): the benchmark is split into
+independently-timed children so a wedged TPU tunnel can never destroy the
+CPU numbers, and a per-stage status record explains exactly what ran:
+
+  1. `--stage cpu`    CPU-native + numpy baselines, run hermetically
+                      (PALLAS_AXON_POOL_IPS unset, JAX_PLATFORMS=cpu) —
+                      cannot touch the TPU tunnel, always yields the
+                      vs_baseline denominator.
+  2. `--stage probe`  `import jax; jax.devices()` only, short timeout,
+                      retried: detects a wedged axon backend cheaply.
+  3. `--stage device` the TPU benches — only launched if the probe saw a
+                      live backend. If the probe failed, the same stage is
+                      re-run hermetically on the CPU jax backend instead,
+                      so the metric still carries measured data (clearly
+                      marked platform=cpu + error).
 
 Environment knobs:
-  CEPH_TPU_BENCH_TIMEOUT   seconds before the child is killed (default 1200)
+  CEPH_TPU_BENCH_TIMEOUT  total budget in seconds (default 1800)
 """
 from __future__ import annotations
 
@@ -17,56 +27,130 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
-TIMEOUT = int(os.environ.get("CEPH_TPU_BENCH_TIMEOUT", "1200"))
+TOTAL_BUDGET = int(os.environ.get("CEPH_TPU_BENCH_TIMEOUT", "1800"))
+CPU_TIMEOUT = 420
+PROBE_TIMEOUT = 150
+PROBE_ATTEMPTS = 3
+METRIC = "ec_encode_k8m3_1MiB_chunk"
+
+_deadline = time.monotonic() + TOTAL_BUDGET
 
 
-def fail(reason: str, detail: str = "") -> None:
-    print(json.dumps({
-        "metric": "ec_encode_k8m3_1MiB_chunk",
-        "value": 0.0,
-        "unit": "GB/s",
-        "vs_baseline": 0.0,
-        "error": reason,
-        "detail": detail[-2000:],
-    }))
+def _budget(want: float) -> float:
+    return max(10.0, min(want, _deadline - time.monotonic()))
 
 
-def main() -> int:
+def _hermetic_env() -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # axon sitecustomize trigger
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _tpu_env() -> dict:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def run_stage(stage: str, env: dict, timeout: float) -> dict:
+    """Run one bench_driver stage; returns {"status", "elapsed_s", ...data}."""
+    t0 = time.monotonic()
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "ceph_tpu.tools.bench_driver"],
+            [sys.executable, "-m", "ceph_tpu.tools.bench_driver",
+             "--stage", stage],
             cwd=REPO, env=env, capture_output=True, text=True,
-            timeout=TIMEOUT)
+            timeout=timeout)
     except subprocess.TimeoutExpired as e:
-        fail(f"benchmark child timed out after {TIMEOUT}s",
-             (e.stderr or b"").decode(errors="replace")
-             if isinstance(e.stderr, bytes) else (e.stderr or ""))
-        return 0
+        stderr = e.stderr
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        return {"status": f"timeout after {timeout:.0f}s",
+                "elapsed_s": round(time.monotonic() - t0, 1),
+                "stderr_tail": (stderr or "")[-800:]}
     except OSError as e:
-        fail(f"could not launch benchmark child: {e}")
-        return 0
-
+        return {"status": f"launch failed: {e}",
+                "elapsed_s": round(time.monotonic() - t0, 1)}
     sys.stderr.write(proc.stderr)
-    line = ""
     for candidate in reversed(proc.stdout.strip().splitlines()):
         candidate = candidate.strip()
         if candidate.startswith("{"):
-            line = candidate
+            try:
+                data = json.loads(candidate)
+            except json.JSONDecodeError:
+                break
+            data["status"] = "ok"
+            data["elapsed_s"] = round(time.monotonic() - t0, 1)
+            return data
+    return {"status": f"no JSON from child (rc={proc.returncode})",
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "stderr_tail": proc.stderr[-800:]}
+
+
+def main() -> int:
+    stages: dict[str, object] = {}
+
+    # Stage 1: CPU baselines — hermetic, hang-proof by construction.
+    cpu = run_stage("cpu", _hermetic_env(), _budget(CPU_TIMEOUT))
+    stages["cpu"] = cpu
+
+    # Stage 2: backend probe, retried — a wedged tunnel costs at most
+    # PROBE_ATTEMPTS * PROBE_TIMEOUT seconds, not the whole budget.
+    probe: dict = {"status": "not run"}
+    attempts = []
+    for i in range(PROBE_ATTEMPTS):
+        if time.monotonic() + PROBE_TIMEOUT > _deadline:
+            attempts.append({"status": "skipped: budget exhausted"})
             break
-    if not line:
-        fail(f"child produced no JSON (rc={proc.returncode})",
-             proc.stderr)
-        return 0
-    try:
-        parsed = json.loads(line)
-    except json.JSONDecodeError:
-        fail("child JSON unparsable", line)
-        return 0
-    print(json.dumps(parsed))
+        probe = run_stage("probe", _tpu_env(), PROBE_TIMEOUT)
+        attempts.append(probe)
+        if probe["status"] == "ok":
+            break
+    stages["probe"] = {"attempts": attempts, "final": probe["status"]}
+
+    # Stage 3: device benches on the probed backend, else CPU-jax fallback.
+    tpu_live = probe.get("status") == "ok"
+    env = _tpu_env() if tpu_live else _hermetic_env()
+    device = run_stage("device", env, _budget(_deadline - time.monotonic()))
+    stages["device"] = device
+
+    detail = {k: v for k, v in cpu.items()
+              if k not in ("status", "elapsed_s", "stderr_tail")}
+    detail.update({k: v for k, v in device.items()
+                   if k not in ("status", "elapsed_s", "stderr_tail")})
+
+    baseline = detail.get("cpu_native_encode") or 0.0
+    baseline_name = "cpu_native_encode (C++ AVX2 split-table, isa stand-in)"
+    if not baseline:
+        baseline = detail.get("cpu_numpy_encode") or 0.0
+        baseline_name = "cpu_numpy_encode (native codec unavailable)"
+
+    value = detail.get("tpu_encode") or 0.0
+    vs = round(value / baseline, 3) if baseline > 0 else 0.0
+    out = {
+        "metric": METRIC,
+        "value": value,
+        "unit": "GB/s",
+        "vs_baseline": vs,
+        "baseline": baseline_name,
+        "platform": device.get("platform", "none"),
+        "detail": detail,
+        "stages": {name: (s if name == "probe"
+                          else {k: s.get(k) for k in
+                                ("status", "elapsed_s", "stderr_tail")
+                                if k in s})
+                   for name, s in stages.items()},
+    }
+    if not tpu_live:
+        out["error"] = ("tpu backend unreachable after "
+                        f"{len(attempts)} probe attempts; device numbers "
+                        "are the hermetic cpu-jax fallback")
+    print(json.dumps(out), flush=True)
     return 0
 
 

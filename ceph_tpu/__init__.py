@@ -7,12 +7,18 @@ runtime (messenger, CRUSH placement, Paxos monitors, PG-based OSDs, client
 library) is rebuilt idiomatically rather than ported.
 
 Subpackages:
-  ec        erasure-code plugin layer (interface, registry, plugins)
-  ops       device kernels (RS bitplane matmul, crc32c, Pallas variants)
-  parallel  device-mesh sharding of the codec pipeline (ICI scale-out)
-  rados     cluster core (crush, maps, messenger, mon, osd, client)
-  utils     runtime substrate (buffers, config, perf counters, logging)
-  tools     CLIs (ec benchmark, object store tools)
+  ec          erasure-code plugin layer (interface, registry, plugins)
+  ops         device kernels (RS bitplane matmul, crc32c — XLA dot_general
+              int8 MXU kernels; no hand-written Pallas needed yet)
+  parallel    device-mesh sharding of the codec pipeline (ICI scale-out)
+  crush       placement: CRUSH hierarchy/rules + OSDMap epochs
+  msg         wire messaging (TLV frames, crc32c, reconnect)
+  mon         monitor: single-Paxos, map distribution, EC profile plane
+  osd         OSD data plane (EC stripe driver, PGs, backends)
+  rados       client library (Objecter-style placement + resend)
+  objectstore local object stores (API, MemStore, file-backed store)
+  utils       runtime substrate (buffers, config, perf counters, logging)
+  tools       CLIs (ec benchmark, object store tools)
 """
 
 __version__ = "0.1.0"

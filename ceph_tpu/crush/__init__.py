@@ -1,6 +1,6 @@
 """CRUSH placement (straw2 buckets + rule engine) and OSDMap."""
 from ceph_tpu.crush.crush import CrushMap, Bucket, Rule, Step, CRUSH_NONE
-from ceph_tpu.crush.osdmap import OSDMap, Pool, PG
+from ceph_tpu.crush.osdmap import OSDMap, Pool, PG, Incremental
 
 __all__ = ["CrushMap", "Bucket", "Rule", "Step", "CRUSH_NONE",
-           "OSDMap", "Pool", "PG"]
+           "OSDMap", "Pool", "PG", "Incremental"]

@@ -84,6 +84,7 @@ class CrushMap:
         self._type_names: dict[int, str] = {0: "osd", 1: "host", 2: "rack",
                                             3: "row", 10: "root"}
         self._next_bucket_id = -1
+        self._domain_counts: dict[tuple[int, int], int] = {}
         self.tries = 50          # choose_total_tries
         # firstn only: when live failure domains are exhausted, place the
         # remaining replicas on already-used domains (never reusing a
@@ -112,6 +113,7 @@ class CrushMap:
             raise ValueError(f"item {item} already in {bucket.name}")
         bucket.items.append(item)
         bucket.weights.append(weight)
+        self._domain_counts.clear()
         if name is not None:
             self._names[name] = item
 
@@ -342,7 +344,12 @@ class CrushMap:
         return [leaf for leaf in leaves if leaf != CRUSH_NONE]
 
     def _count_domains(self, parent: int, target_type: int) -> int:
-        """Number of distinct items of target_type in the subtree of parent."""
+        """Number of distinct items of target_type in the subtree of parent.
+        Cached per (parent, type); invalidated when topology changes."""
+        key = (parent, target_type)
+        cached = self._domain_counts.get(key)
+        if cached is not None:
+            return cached
         count = 0
         stack = [parent]
         while stack:
@@ -358,4 +365,5 @@ class CrushMap:
                 count += 1
                 continue
             stack.extend(bucket.items)
+        self._domain_counts[key] = count
         return count

@@ -6,7 +6,8 @@ pg_num, size/min_size, crush rule, EC profile name), and the placement
 pipeline `pg_to_up_acting_osds` (:2923) = raw CRUSH mapping (:2670
 `_pg_to_raw_osds`: x = stable_mod seed, crush.do_rule with the reweight
 vector) + pg_temp overrides. Epochs advance through `Incremental` deltas
-so daemons converge on identical maps from any starting epoch.
+(`apply_incremental`) so daemons converge on identical maps from any
+starting epoch; full-map encode/decode exists for bootstrap.
 """
 from __future__ import annotations
 
@@ -65,6 +66,66 @@ class OsdState:
     in_cluster: bool = True
     weight: float = 1.0               # reweight in [0,1]
     addr: str = ""
+
+
+@dataclasses.dataclass
+class Incremental:
+    """Delta between OSDMap epoch-1 and epoch (OSDMap::Incremental,
+    src/osd/OSDMap.h): daemons at any older epoch apply the chain of
+    incrementals the monitor publishes and converge on an identical map
+    without refetching the full map each time.
+
+    Fields left at their sentinel are "no change". new_pools carries full
+    Pool records (pool mutations are rare and small); new_pg_temp maps a
+    PG to its override list, [] meaning "erase the override".
+    """
+    epoch: int = 0                               # the epoch this produces
+    new_up: dict[int, str] = dataclasses.field(default_factory=dict)
+    # osd -> addr of the newly-up daemon
+    new_down: list[int] = dataclasses.field(default_factory=list)
+    new_in: list[int] = dataclasses.field(default_factory=list)
+    new_out: list[int] = dataclasses.field(default_factory=list)
+    new_weights: dict[int, float] = dataclasses.field(default_factory=dict)
+    new_osds: dict[int, str] = dataclasses.field(default_factory=dict)
+    new_pools: dict[int, Pool] = dataclasses.field(default_factory=dict)
+    new_pg_temp: dict[PG, list[int]] = dataclasses.field(default_factory=dict)
+
+    def empty(self) -> bool:
+        return not (self.new_up or self.new_down or self.new_in
+                    or self.new_out or self.new_weights or self.new_osds
+                    or self.new_pools or self.new_pg_temp)
+
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "new_up": {str(o): a for o, a in self.new_up.items()},
+            "new_down": self.new_down,
+            "new_in": self.new_in,
+            "new_out": self.new_out,
+            "new_weights": {str(o): w for o, w in self.new_weights.items()},
+            "new_osds": {str(o): a for o, a in self.new_osds.items()},
+            "new_pools": {str(p): dataclasses.asdict(pool)
+                          for p, pool in self.new_pools.items()},
+            "new_pg_temp": {str(pg): osds
+                            for pg, osds in self.new_pg_temp.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Incremental":
+        inc = cls(epoch=d["epoch"])
+        inc.new_up = {int(o): a for o, a in d.get("new_up", {}).items()}
+        inc.new_down = list(d.get("new_down", []))
+        inc.new_in = list(d.get("new_in", []))
+        inc.new_out = list(d.get("new_out", []))
+        inc.new_weights = {int(o): w
+                           for o, w in d.get("new_weights", {}).items()}
+        inc.new_osds = {int(o): a for o, a in d.get("new_osds", {}).items()}
+        inc.new_pools = {int(p): Pool(**pool)
+                         for p, pool in d.get("new_pools", {}).items()}
+        for key, osds in d.get("new_pg_temp", {}).items():
+            pool_s, ps_s = key.split(".")
+            inc.new_pg_temp[PG(int(pool_s), int(ps_s, 16))] = list(osds)
+        return inc
 
 
 class OSDMap:
@@ -158,6 +219,41 @@ class OSDMap:
     def inc_epoch(self) -> int:
         self.epoch += 1
         return self.epoch
+
+    def apply_incremental(self, inc: Incremental) -> None:
+        """Advance this map by one epoch delta (OSDMap::apply_incremental,
+        src/osd/OSDMap.cc). Raises if the delta isn't for epoch+1 —
+        callers must fetch intervening incrementals (or a full map) first.
+        """
+        if inc.epoch != self.epoch + 1:
+            raise ValueError(
+                f"incremental for epoch {inc.epoch} cannot apply to "
+                f"map at epoch {self.epoch}")
+        for osd, addr in inc.new_osds.items():
+            if osd not in self.osds:
+                self.add_osd(osd, addr=addr)
+        for osd, addr in inc.new_up.items():
+            self.set_up(osd, True, addr=addr)
+        for osd in inc.new_down:
+            self.set_up(osd, False)
+        for osd in inc.new_in:
+            self.set_in(osd, True)
+        for osd in inc.new_out:
+            self.set_in(osd, False)
+        for osd, w in inc.new_weights.items():
+            self.reweight(osd, w)
+        if inc.new_pools:
+            self.pools.update(inc.new_pools)
+            # rebuild rather than insert: a renamed pool must drop its old
+            # name or incremental-appliers diverge from full-map bootstrap
+            self.pool_names = {pool.name: pid
+                               for pid, pool in self.pools.items()}
+        for pg, osds in inc.new_pg_temp.items():
+            if osds:
+                self.pg_temp[pg] = list(osds)
+            else:
+                self.pg_temp.pop(pg, None)
+        self.epoch = inc.epoch
 
     # -- encode/decode (wire form for map distribution) ----------------------
 

@@ -5,10 +5,14 @@ decode submatrices, and converting GF(2^8) matrices to GF(2) bitmatrices that
 the TPU data path (bitplane matmul / XOR networks, see ceph_tpu.ops) executes.
 
 All arithmetic uses the primitive polynomial x^8 + x^4 + x^3 + x^2 + 1
-(0x11D), the field used by both jerasure/gf-complete (w=8) and Intel ISA-L,
-so chunk bytes are interoperable with the reference plugins
+(0x11D), the field used by both jerasure/gf-complete (w=8) and Intel ISA-L
 (reference: src/erasure-code/jerasure/ErasureCodeJerasure.cc,
-src/erasure-code/isa/ErasureCodeIsa.cc:388-390).
+src/erasure-code/isa/ErasureCodeIsa.cc:388-390). The tables and matrix
+constructions are cross-validated against an independent from-scratch
+implementation (peasant multiply + Fermat inversion) in
+tests/test_gf256_independent.py; interop with chunks from real jerasure
+binaries is construction-level (the submodules aren't available here to
+bit-verify against).
 
 Matrix constructions follow the published algorithms (Plank, "A Tutorial on
 Reed-Solomon Coding for Fault-Tolerance in RAID-like Systems" + the 2003
@@ -160,7 +164,7 @@ def mat_invert(M: np.ndarray) -> np.ndarray:
 def reed_sol_van_matrix(k: int, m: int) -> np.ndarray:
     """Systematic Vandermonde RS coding matrix (m, k), jerasure reed_sol_van.
 
-    Byte-compatible with jerasure's reed_sol_vandermonde_coding_matrix (the
+    Construction-compatible with jerasure's reed_sol_vandermonde_coding_matrix (the
     published Plank algorithm wrapped by reference
     src/erasure-code/jerasure/ErasureCodeJerasure.cc:162): build the
     *extended* Vandermonde matrix — first row e_0, last row e_{k-1}, middle

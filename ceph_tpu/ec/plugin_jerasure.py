@@ -7,7 +7,8 @@ dispatched by the profile's `technique` key
 coding matrix once at init (ErasureCodeJerasure.cc:203). Instead of
 jerasure's GF tables + SIMD loops, all techniques lower to the shared
 bitplane-matmul codec (ceph_tpu.ops.rs_codec), so the same code runs the
-w=8 byte-compatible math on CPU or TPU.
+w=8 field math on CPU or TPU (construction-compatible with jerasure;
+independently cross-validated in tests/test_gf256_independent.py).
 
 Supported techniques: reed_sol_van, reed_sol_r6_op, cauchy_orig, cauchy_good.
 The minimal-density bitmatrix RAID-6 family (liberation, blaum_roth,

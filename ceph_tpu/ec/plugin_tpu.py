@@ -17,10 +17,14 @@ So the plugin exposes, beyond the scalar interface:
   - device-resident mode for callers that keep chunks in HBM (the OSD
     bridge and the benchmark steady state).
 
-Techniques: reed_sol_van (default, byte-compatible with jerasure),
-cauchy_good. Chunk bytes are identical to the jerasure plugin's for the
-same technique, so `tpu` can decode stripes encoded by `jerasure` and
-vice versa.
+Techniques: reed_sol_van (default), cauchy_good. Matrices follow the
+published jerasure constructions (Plank-Ding 2005 extended-Vandermonde
+systematization; Plank-Xu 2006 cauchy_good) over the same field (0x11D),
+validated in-repo against an independent from-scratch re-derivation
+(tests/test_gf256_independent.py: peasant-multiply arithmetic, Fermat
+inversion, full 256x256 table cross-check). A live jerasure build is not
+available here, so interop with real jerasure-encoded chunks is
+construction-level compatible, not verified against jerasure binaries.
 """
 from __future__ import annotations
 

@@ -6,8 +6,7 @@ Python analog of the reference's dlopen registry
 `__erasure_code_init__(name, directory)` which must register an
 ErasureCodePlugin (:145-171). Built-in plugins resolve to
 `ceph_tpu.ec.plugin_<name>`; external directories are searched for
-`ec_<name>.py` the way the reference searches `libec_<name>.so`. The C++
-dlopen mirror of this registry lives in native/.
+`ec_<name>.py` the way the reference searches `libec_<name>.so`.
 """
 from __future__ import annotations
 

@@ -1,1 +1,1 @@
-"""OSD data-plane components (EC stripe driver, transactions, backends)."""
+"""OSD data-plane components. Currently: EC stripe driver (ec_util)."""

@@ -152,6 +152,23 @@ def encode(sinfo: StripeInfo, ec_impl, data: bytes | np.ndarray,
     return {i: full[:, i, :].tobytes() for i in sorted(want)}
 
 
+def _batched_reconstruct(ec_impl, stacked: Mapping[int, np.ndarray],
+                         helpers: list[int], want: list[int]) -> dict[int, np.ndarray]:
+    """One-dispatch reconstruction of `want` shards from per-shard
+    (n, chunk_size) planes via the plugin's decode_stripes batch API.
+    Shared by the degraded-read and shard-recovery paths so the dispatch
+    contract (first-k helper order, (n, k, C) stacking) lives in one place.
+    """
+    k = ec_impl.get_data_chunk_count()
+    use = tuple(helpers[:k])
+    if len(use) < k:
+        raise ErasureCodeError(
+            f"cannot decode: {len(use)} shards available, need {k}")
+    src = np.stack([stacked[i] for i in use], axis=1)       # (n, k, C)
+    rec = np.asarray(ec_impl.decode_stripes(use, tuple(want), src))
+    return {wid: rec[:, j, :] for j, wid in enumerate(want)}
+
+
 def decode_concat(sinfo: StripeInfo, ec_impl,
                   to_decode: Mapping[int, bytes]) -> bytes:
     """Reconstruct and concatenate the data shards in rank order — the
@@ -188,13 +205,7 @@ def decode_concat(sinfo: StripeInfo, ec_impl,
             out[:, rank, :] = stacked[cid]
         return out.tobytes()
     if hasattr(ec_impl, "decode_stripes") and not mapping:
-        use = tuple(avail_ids[:k])
-        if len(use) < k:
-            raise ErasureCodeError(
-                f"cannot decode: {len(use)} shards available, need {k}")
-        src = np.stack([stacked[i] for i in use], axis=1)  # (S, k, C)
-        rec = np.asarray(ec_impl.decode_stripes(use, tuple(missing), src))
-        recovered = {mid: rec[:, j, :] for j, mid in enumerate(missing)}
+        recovered = _batched_reconstruct(ec_impl, stacked, avail_ids, missing)
         out = np.empty((n_stripes, k, sinfo.chunk_size), dtype=np.uint8)
         for rank, cid in enumerate(want):
             out[:, rank, :] = stacked[cid] if cid in stacked else recovered[cid]
@@ -223,19 +234,50 @@ def decode_shards(sinfo: StripeInfo, ec_impl, to_decode: Mapping[int, bytes],
     if not arrays:
         raise ErasureCodeError("no chunks to decode")
     minimum = ec_impl.minimum_to_decode(need, set(arrays))
+    missing_helpers = sorted(set(minimum) - set(arrays))
+    if missing_helpers:
+        raise ErasureCodeError(
+            f"repair plan needs shards {missing_helpers} that were not "
+            f"fetched (have {sorted(arrays)})")
     sub = ec_impl.get_sub_chunk_count()
     subchunk_size = sinfo.chunk_size // sub
-    any_min = next(iter(minimum.values()))
-    repair_per_chunk = sum(cnt for _, cnt in any_min) * subchunk_size
-    total = next(iter(arrays.values())).size
+    # the repair plan must be homogeneous: every helper contributes the
+    # same number of sub-chunks per chunk, or the fixed-stride slicing
+    # below would mis-slice the fetched buffers (ADVICE r2)
+    plan_counts = {i: sum(cnt for _, cnt in runs)
+                   for i, runs in minimum.items()}
+    if len(set(plan_counts.values())) != 1:
+        raise ErasureCodeError(
+            f"heterogeneous repair plan (sub-chunks per chunk by shard): "
+            f"{plan_counts}")
+    repair_per_chunk = next(iter(plan_counts.values())) * subchunk_size
+    helpers = sorted(minimum)
+    sizes = {arrays[i].size for i in helpers}
+    if len(sizes) != 1:
+        raise ErasureCodeError(
+            f"helper shard buffers differ in length: "
+            f"{ {i: arrays[i].size for i in helpers} }")
+    total = sizes.pop()
     if total % repair_per_chunk:
         raise ErasureCodeError("shard buffer not aligned to repair unit")
     n_chunks = total // repair_per_chunk
 
+    if (sub == 1 and not ec_impl.get_chunk_mapping()
+            and hasattr(ec_impl, "decode_stripes") and n_chunks > 0):
+        # whole-chunk repair on a batch-capable plugin: ONE device dispatch
+        # for all n_chunks repair units instead of a host round trip per
+        # chunk — the recovery path is the most bandwidth-hungry consumer
+        # (reference batching site: src/osd/ECUtil.cc:61-131)
+        stacked = {i: arrays[i].reshape(n_chunks, sinfo.chunk_size)
+                   for i in helpers}
+        recovered = _batched_reconstruct(ec_impl, stacked, helpers, need)
+        return {nid: plane.tobytes() for nid, plane in recovered.items()}
+
     outs = {i: [] for i in need}
     for c in range(n_chunks):
-        chunks = {i: a[c * repair_per_chunk:(c + 1) * repair_per_chunk].tobytes()
-                  for i, a in arrays.items()}
+        chunks = {i: arrays[i][c * repair_per_chunk:
+                               (c + 1) * repair_per_chunk].tobytes()
+                  for i in helpers}
         decoded = ec_impl.decode(need, chunks, sinfo.chunk_size)
         for i in need:
             if len(decoded[i]) != sinfo.chunk_size:

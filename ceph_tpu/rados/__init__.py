@@ -1,0 +1,6 @@
+"""Client library: Objecter-style placement + resend (under construction).
+
+Will hold the librados-subset client (reference src/osdc/Objecter.cc,
+src/librados/): object->PG->OSD targeting from the current OSDMap epoch
+and resend-on-map-change. Empty until that lands; nothing is re-exported.
+"""

@@ -1,96 +1,128 @@
-"""Measurement child for bench.py — runs in its own process so the parent
-can enforce a hard timeout (JAX backend init can hang in broken
-environments; the benchmark must never do so).
+"""Measurement children for bench.py — each stage runs in its own process
+so the parent can enforce per-stage wall-clock timeouts. A wedged TPU
+tunnel (observed: `jax.devices()` under the axon platform hanging forever
+during backend init) must never cost the CPU baselines their numbers.
 
-Measures, for the north-star config (k=8, m=3, chunk = 1 MiB, i.e. the
-reference `ceph_erasure_code_benchmark -P k=8 -P m=3 -s 8M` geometry,
-BASELINE.md):
+Stages (`python -m ceph_tpu.tools.bench_driver --stage X`):
 
-  cpu_native_encode   C++ split-table SIMD codec (isa-plugin stand-in)
-  cpu_native_decode   same kernel applied to the 3-erasure recovery matrix
-  tpu_encode          batched device-resident encode_stripes
-  tpu_decode          batched device-resident decode_stripes (3 erasures)
-  tpu_encode_host     batched encode with host numpy in/out (includes H2D/D2H)
-  scalar_encode       per-stripe plugin-contract encode() (reference loop)
+  cpu     CPU baselines only. The parent runs this hermetically
+          (PALLAS_AXON_POOL_IPS unset, JAX_PLATFORMS=cpu) so even a
+          transitive jax import cannot dial the TPU tunnel.
+            cpu_native_encode   C++ split-table SIMD codec (isa stand-in)
+            cpu_native_decode   same kernel, 3-erasure recovery matrix
+            cpu_numpy_encode    pure-numpy GF(2^8) matrix apply
+            cpu_crc32c          C++ slice-by-8 crc32c over 4 KiB blocks
+  probe   `import jax; jax.devices()` and nothing else; prints platform.
+          Cheap enough to retry a few times under a short timeout.
+  device  Device benches (run only after a successful probe):
+            tpu_encode          batched device-resident encode_stripes
+            tpu_decode          batched device-resident decode_stripes
+            tpu_crc32c          device crc32c kernel
+            tpu_encode_host     batched encode incl. H2D/D2H transfers
+            scalar_encode       per-stripe plugin-contract encode()
 
-Prints exactly one JSON line on stdout; everything else goes to stderr.
+North-star config throughout: k=8, m=3, chunk = 1 MiB — the reference
+`ceph_erasure_code_benchmark -P k=8 -P m=3 -s 8M` geometry
+(src/test/erasure-code/ceph_erasure_code_benchmark.cc:186-193,297-324;
+GB/s = KiB/2^20/seconds per qa/workunits/erasure-code/bench.sh:214).
+
+Each stage prints exactly one JSON line on stdout; logs go to stderr.
 """
 from __future__ import annotations
 
+import argparse
 import json
-import os
 import sys
 import time
 
 import numpy as np
+
+K, M = 8, 3
+CHUNK = 1 << 20                    # 1 MiB chunk
+SIZE = K * CHUNK                   # 8 MiB stripe buffer
+PARAMS = {"k": str(K), "m": str(M)}
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> int:
-    t_start = time.perf_counter()
-    import jax
+def _bench_into(results: dict, name: str, **kw) -> float:
+    from ceph_tpu.tools.ec_benchmark import BenchConfig, run_bench
+    cfg = BenchConfig(parameters=dict(PARAMS), size=SIZE,
+                      erasures=M, seed=42, **kw)
+    try:
+        r = run_bench(cfg)
+        results[name] = round(r.gb_per_s, 4)
+        log(f"{name}: {r.gb_per_s:.3f} GB/s ({r.seconds:.3f}s)")
+        return r.gb_per_s
+    except Exception as e:  # record and continue; one failure != no data
+        log(f"{name}: FAILED {type(e).__name__}: {e}")
+        results[name] = 0.0
+        return 0.0
 
+
+def stage_cpu() -> dict:
+    results: dict[str, float] = {}
+    _bench_into(results, "cpu_native_encode", plugin="isa", mode="native",
+                workload="encode", iterations=40, warmup=3)
+    _bench_into(results, "cpu_native_decode", plugin="isa", mode="native",
+                workload="decode", iterations=40, warmup=3)
+    _bench_into(results, "cpu_numpy_encode", plugin="isa", mode="baseline",
+                workload="encode", iterations=3, warmup=1)
+    # crc32c Checksummer host baseline (BASELINE: 4 KiB blocks; the 10^6
+    # block scale is reached by iterating the per-call block batch)
+    try:
+        from ceph_tpu.native import ec_native
+        from ceph_tpu.tools.ec_benchmark import _time_host_loop
+        nblocks = 1 << 14
+        gib = nblocks * 4096 / (1 << 30)
+        blocks = np.random.default_rng(0).integers(
+            0, 256, (nblocks, 4096), dtype=np.uint8)
+        iters = 8
+        dt = _time_host_loop(lambda: ec_native.crc32c_blocks(blocks, 4096),
+                             iters, 1)
+        results["cpu_crc32c"] = round(iters * gib / dt, 4)
+        log(f"cpu_crc32c: {results['cpu_crc32c']} GB/s")
+    except Exception as e:
+        log(f"cpu_crc32c: FAILED {type(e).__name__}: {e}")
+        results["cpu_crc32c"] = 0.0
+    return results
+
+
+def stage_probe() -> dict:
+    t0 = time.perf_counter()
+    import jax
+    devices = jax.devices()
+    return {
+        "platform": devices[0].platform,
+        "device_count": len(devices),
+        "init_s": round(time.perf_counter() - t0, 1),
+    }
+
+
+def stage_device() -> dict:
+    t0 = time.perf_counter()
+    import jax
     platform = jax.devices()[0].platform
     log(f"jax backend up: {platform} x{len(jax.devices())} "
-        f"({time.perf_counter() - t_start:.1f}s)")
-
-    from ceph_tpu.tools.ec_benchmark import BenchConfig, run_bench
-
-    k, m = 8, 3
-    chunk = 1 << 20                    # 1 MiB chunk
-    size = k * chunk                   # 8 MiB stripe buffer
+        f"({time.perf_counter() - t0:.1f}s)")
     on_tpu = platform == "tpu"
     batch = 16 if on_tpu else 4
     iters = 40 if on_tpu else 2
-    params = {"k": str(k), "m": str(m)}
-    results: dict[str, float] = {}
 
-    def bench(name: str, **kw) -> float:
-        cfg = BenchConfig(parameters=dict(params), size=size,
-                          erasures=m, seed=42, **kw)
-        try:
-            r = run_bench(cfg)
-            results[name] = round(r.gb_per_s, 4)
-            log(f"{name}: {r.gb_per_s:.3f} GB/s ({r.seconds:.3f}s)")
-            return r.gb_per_s
-        except Exception as e:  # record and continue; one failure != no data
-            log(f"{name}: FAILED {type(e).__name__}: {e}")
-            results[name] = 0.0
-            return 0.0
+    results: dict[str, float] = {"platform": platform}
+    _bench_into(results, "tpu_encode", plugin="tpu", mode="batched",
+                workload="encode", batch=batch, iterations=iters, warmup=2)
+    _bench_into(results, "tpu_decode", plugin="tpu", mode="batched",
+                workload="decode", batch=batch, iterations=iters, warmup=2)
 
-    bench("cpu_native_encode", plugin="isa", mode="native",
-          workload="encode", iterations=40, warmup=3)
-    bench("cpu_native_decode", plugin="isa", mode="native",
-          workload="decode", iterations=40, warmup=3)
-    bench("cpu_numpy_encode", plugin="isa", mode="baseline",
-          workload="encode", iterations=3, warmup=1)
-    tpu_enc = bench("tpu_encode", plugin="tpu", mode="batched",
-                    workload="encode", batch=batch, iterations=iters, warmup=2)
-    bench("tpu_decode", plugin="tpu", mode="batched",
-          workload="decode", batch=batch, iterations=iters, warmup=2)
-    # crc32c Checksummer batch (BASELINE: 4 KiB blocks; 10^6-block scale is
-    # reached by iterating a 64Ki-block dispatch)
-    from ceph_tpu.tools.ec_benchmark import (_device_test_data,
-                                             _time_device_loop,
-                                             _time_host_loop)
-    nblocks = 1 << 16 if on_tpu else 1 << 12
-    gib = nblocks * 4096 / (1 << 30)
-    try:
-        from ceph_tpu.native import ec_native
-        blocks = np.random.default_rng(0).integers(
-            0, 256, (nblocks, 4096), dtype=np.uint8)
-        host_iters = 4
-        dt = _time_host_loop(lambda: ec_native.crc32c_blocks(blocks, 4096),
-                             host_iters, 1)
-        results["cpu_crc32c"] = round(host_iters * gib / dt, 4)
-        log(f"cpu_crc32c: {results['cpu_crc32c']} GB/s")
-    except Exception as e:
-        log(f"cpu crc32c bench FAILED {type(e).__name__}: {e}")
     try:
         from ceph_tpu.ops import crc32c as crc_dev
+        from ceph_tpu.tools.ec_benchmark import (_device_test_data,
+                                                 _time_device_loop)
+        nblocks = 1 << 16 if on_tpu else 1 << 12
+        gib = nblocks * 4096 / (1 << 30)
         dev_crc = crc_dev.get_device_crc(4096)
         # generated on device: H2D through the tunnel is ~5 MB/s
         dev_blocks = _device_test_data(nblocks, 1, 4096).reshape(nblocks, 4096)
@@ -100,33 +132,27 @@ def main() -> int:
         log(f"tpu_crc32c: {results['tpu_crc32c']} GB/s "
             f"({crc_iters * nblocks} blocks total)")
     except Exception as e:
-        log(f"tpu crc32c bench FAILED {type(e).__name__}: {e}")
+        log(f"tpu_crc32c: FAILED {type(e).__name__}: {e}")
+        results["tpu_crc32c"] = 0.0
 
-    # Host-buffer paths pay H2D/D2H; through the remote-TPU tunnel that link
-    # is ~5 MB/s, so keep these small — they document the transfer cost, the
-    # device-resident numbers above are the capability measurement.
-    bench("tpu_encode_host", plugin="tpu", mode="batched-host",
-          workload="encode", batch=4, iterations=1, warmup=1)
-    bench("scalar_encode", plugin="tpu", mode="scalar",
-          workload="encode", iterations=2, warmup=1)
+    # Host-buffer paths pay H2D/D2H; through the remote-TPU tunnel that
+    # link is ~5 MB/s, so keep these tiny — they document transfer cost,
+    # the device-resident numbers above are the capability measurement.
+    _bench_into(results, "tpu_encode_host", plugin="tpu", mode="batched-host",
+                workload="encode", batch=4, iterations=1, warmup=1)
+    _bench_into(results, "scalar_encode", plugin="tpu", mode="scalar",
+                workload="encode", iterations=2, warmup=1)
+    results["elapsed_s"] = round(time.perf_counter() - t0, 1)
+    return results
 
-    if results.get("cpu_native_encode"):
-        baseline = results["cpu_native_encode"]
-        baseline_name = "cpu_native_encode (C++ AVX2 split-table, isa stand-in)"
-    else:
-        baseline = results.get("cpu_numpy_encode", 0.0)
-        baseline_name = "cpu_numpy_encode (native codec unavailable)"
-    vs = round(tpu_enc / baseline, 3) if baseline > 0 else 0.0
-    out = {
-        "metric": "ec_encode_k8m3_1MiB_chunk",
-        "value": results.get("tpu_encode", 0.0),
-        "unit": "GB/s",
-        "vs_baseline": vs,
-        "baseline": baseline_name,
-        "platform": platform,
-        "detail": results,
-        "elapsed_s": round(time.perf_counter() - t_start, 1),
-    }
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--stage", choices=["cpu", "probe", "device"],
+                   required=True)
+    args = p.parse_args()
+    out = {"cpu": stage_cpu, "probe": stage_probe,
+           "device": stage_device}[args.stage]()
     print(json.dumps(out), flush=True)
     return 0
 

@@ -235,6 +235,42 @@ def test_osdmap_roundtrip_wire():
     assert om2.osds[0].addr == "127.0.0.1:6800"
 
 
+def test_osdmap_incremental_chain():
+    """Two replicas of the map converge by applying the same incrementals
+    (OSDMap::apply_incremental); wire round-trip included."""
+    import json
+    from ceph_tpu.crush.osdmap import Incremental
+    om, n = _osdmap()
+    follower = OSDMap(om.crush)
+    follower.load_dict(json.loads(om.dumps()))
+
+    from ceph_tpu.crush.osdmap import Pool
+    inc = Incremental(epoch=om.epoch + 1)
+    inc.new_down = [3]
+    inc.new_out = [3]
+    inc.new_weights = {4: 0.5}
+    inc.new_pools = {7: Pool(id=7, name="p2", size=3, pg_num=8)}
+    inc.new_pg_temp = {PG(1, 4): [9, 10, 11]}
+    # wire round-trip
+    inc2 = Incremental.from_dict(json.loads(json.dumps(inc.to_dict())))
+
+    om.apply_incremental(inc)
+    follower.apply_incremental(inc2)
+    assert om.epoch == follower.epoch
+    assert not follower.osds[3].up and not follower.osds[3].in_cluster
+    assert follower.osds[4].weight == 0.5
+    assert follower.get_pool("p2").id == 7
+    assert follower.pg_temp[PG(1, 4)] == [9, 10, 11]
+    assert json.loads(om.dumps()) == json.loads(follower.dumps())
+
+    # erase pg_temp via empty list; reject out-of-order epochs
+    inc3 = Incremental(epoch=om.epoch + 1, new_pg_temp={PG(1, 4): []})
+    om.apply_incremental(inc3)
+    assert PG(1, 4) not in om.pg_temp
+    with pytest.raises(ValueError):
+        om.apply_incremental(inc3)  # same epoch again -> reject
+
+
 def test_stable_mod_growth():
     from ceph_tpu.crush.osdmap import stable_mod
     # growing pg_num 8 -> 12 must keep pgs < 8 stable where possible

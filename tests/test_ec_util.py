@@ -112,6 +112,60 @@ def test_decode_shards_rebuilds_parity_and_data():
         assert rebuilt[i] == shards[i]
 
 
+def test_decode_shards_batches_into_one_dispatch():
+    """Reconstructing a 64-chunk shard must be O(1) device dispatches, not
+    one per chunk (VERDICT r2 #5; reference batching site ECUtil.cc:61-131)."""
+    k, m = 4, 2
+    code = _plugin("tpu", k, m)
+    chunk = code.get_chunk_size(4 * 256)
+    si = StripeInfo(k, k * chunk)
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 256, 64 * si.stripe_width, dtype=np.uint8).tobytes()
+    shards = ec_util.encode(si, code, data)
+
+    calls = {"batched": 0, "scalar": 0}
+
+    class Counting:
+        def __getattr__(self, name):
+            if name == "decode_stripes":
+                def spy(avail_ids, want_ids, chunks):
+                    calls["batched"] += 1
+                    return code.decode_stripes(avail_ids, want_ids, chunks)
+                return spy
+            if name == "decode":
+                def spy(need, chunks, chunk_size):
+                    calls["scalar"] += 1
+                    return code.decode(need, chunks, chunk_size)
+                return spy
+            return getattr(code, name)
+
+    lost = [1, k]           # one data shard + one parity shard
+    avail = {i: shards[i] for i in range(k + m) if i not in lost}
+    rebuilt = ec_util.decode_shards(si, Counting(), avail, lost)
+    for i in lost:
+        assert rebuilt[i] == shards[i]
+    assert calls["batched"] == 1 and calls["scalar"] == 0
+
+
+def test_decode_shards_rejects_missing_helper_and_bad_lengths():
+    k, m = 4, 2
+    code = _plugin("tpu", k, m)
+    chunk = code.get_chunk_size(4 * 256)
+    si = StripeInfo(k, k * chunk)
+    rng = np.random.default_rng(8)
+    data = rng.integers(0, 256, 2 * si.stripe_width, dtype=np.uint8).tobytes()
+    shards = ec_util.encode(si, code, data)
+
+    # fetched fewer shards than the plan requires
+    with pytest.raises(ErasureCodeError):
+        ec_util.decode_shards(si, code, {0: shards[0], 1: shards[1]}, [5])
+    # helper buffers of unequal length
+    avail = {i: shards[i] for i in range(k)}
+    avail[2] = avail[2][:-chunk]
+    with pytest.raises(ErasureCodeError):
+        ec_util.decode_shards(si, code, avail, [k + 1])
+
+
 def test_encode_rejects_misaligned():
     code = _plugin("tpu", 4, 2)
     si = StripeInfo(4, 4 * code.get_chunk_size(1024))
