@@ -1,0 +1,108 @@
+"""msgr2-subset frame format: TLV preamble + crc32c-protected segments.
+
+Modeled on the reference's frames_v2.h (src/msg/async/frames_v2.h:39-115):
+a frame is a fixed preamble block — tag, segment count, segment lengths,
+preamble crc — followed by the segment payloads, each with its own
+trailing crc32c. Differences from the reference, by design: crc mode only
+(no AES-GCM secure mode, no on-wire compression), at most 4 segments
+(same MAX_NUM_SEGMENTS), no multi-block preambles, and little-endian
+fixed-width ints via struct rather than ceph's dencoder.
+
+Layout (little-endian):
+
+  preamble:  magic u16 = 0xEC02 | tag u8 | seg_count u8
+             | seg_len u32 * seg_count | crc32c(preamble so far) u32
+  body:      for each segment: raw bytes | crc32c(bytes) u32
+
+crc32c is the same Castagnoli polynomial the reference uses everywhere,
+provided by the in-repo C++ kernel (native/ec_native.cc).
+"""
+from __future__ import annotations
+
+import enum
+import struct
+from dataclasses import dataclass, field
+
+from ceph_tpu.native import ec_native
+
+MAGIC = 0xEC02
+MAX_SEGMENTS = 4
+_PRE_FIXED = struct.Struct("<HBB")
+_U32 = struct.Struct("<I")
+
+
+def crc32c(data: bytes, seed: int = 0) -> int:
+    return ec_native.crc32c(data, seed)
+
+
+class Tag(enum.IntEnum):
+    """Frame tags (frames_v2.h:39-60 subset)."""
+    HELLO = 1
+    RECONNECT = 2
+    RECONNECT_OK = 3
+    RESET = 4
+    ACK = 8
+    KEEPALIVE = 9
+    KEEPALIVE_ACK = 10
+    MESSAGE = 16
+
+
+class FrameError(Exception):
+    """Framing violation: bad magic, crc mismatch, oversized segment."""
+
+
+@dataclass
+class Frame:
+    tag: Tag
+    segments: list[bytes] = field(default_factory=list)
+
+    MAX_SEGMENT_SIZE = 128 << 20   # sanity bound; a segment is <= one op
+
+    def encode(self) -> bytes:
+        if not 0 <= len(self.segments) <= MAX_SEGMENTS:
+            raise FrameError(f"{len(self.segments)} segments (max "
+                             f"{MAX_SEGMENTS})")
+        pre = bytearray(_PRE_FIXED.pack(MAGIC, int(self.tag),
+                                        len(self.segments)))
+        for seg in self.segments:
+            pre += _U32.pack(len(seg))
+        pre += _U32.pack(crc32c(bytes(pre)))
+        out = bytearray(pre)
+        for seg in self.segments:
+            out += seg
+            out += _U32.pack(crc32c(seg))
+        return bytes(out)
+
+    @classmethod
+    async def read(cls, reader) -> "Frame":
+        """Read one frame from an asyncio StreamReader."""
+        fixed = await reader.readexactly(_PRE_FIXED.size)
+        magic, tag, nseg = _PRE_FIXED.unpack(fixed)
+        if magic != MAGIC:
+            raise FrameError(f"bad magic {magic:#x}")
+        if nseg > MAX_SEGMENTS:
+            raise FrameError(f"{nseg} segments (max {MAX_SEGMENTS})")
+        rest = await reader.readexactly(4 * nseg + 4)
+        seg_lens = [_U32.unpack_from(rest, 4 * i)[0] for i in range(nseg)]
+        (pre_crc,) = _U32.unpack_from(rest, 4 * nseg)
+        actual = crc32c(fixed + rest[:4 * nseg])
+        if actual != pre_crc:
+            raise FrameError(f"preamble crc {actual:#x} != {pre_crc:#x}")
+        segments = []
+        for ln in seg_lens:
+            if ln > cls.MAX_SEGMENT_SIZE:
+                raise FrameError(f"segment of {ln} bytes exceeds bound")
+            seg = await reader.readexactly(ln)
+            (seg_crc,) = _U32.unpack(await reader.readexactly(4))
+            actual = crc32c(seg)
+            if actual != seg_crc:
+                raise FrameError(f"segment crc {actual:#x} != {seg_crc:#x}")
+            segments.append(seg)
+        try:
+            tag = Tag(tag)
+        except ValueError as e:
+            raise FrameError(f"unknown tag {tag}") from e
+        return cls(tag, segments)
+
+
+BANNER = b"ceph_tpu msgr2.0\n"

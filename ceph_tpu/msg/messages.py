@@ -1,0 +1,120 @@
+"""Typed messages — the src/messages/ equivalent.
+
+A Message is (type id, metadata dict, data bytes). On the wire it rides a
+MESSAGE frame as three segments: header (seq/type, JSON), payload
+(type-specific metadata, JSON), data (raw bytes, untouched — chunk
+payloads never pass through JSON). Subclasses declare `TYPE` and carry
+their fields in `payload`/`data`; `register_message` fills the decode
+registry the way src/messages/MessageFactory.cc maps type ids to
+constructors.
+
+JSON for metadata is a deliberate divergence from ceph's dencoder: these
+are control-plane fields (a few hundred bytes); the data plane stays raw
+bytes. Compact, debuggable, and versionable via key presence.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any
+
+_REGISTRY: dict[int, type] = {}
+
+
+def register_message(cls):
+    """Class decorator: register by TYPE for decode."""
+    if cls.TYPE in _REGISTRY:
+        raise ValueError(f"message type {cls.TYPE} already registered "
+                         f"({_REGISTRY[cls.TYPE].__name__})")
+    _REGISTRY[cls.TYPE] = cls
+    return cls
+
+
+class Message:
+    """Base message. Subclasses set TYPE and may override describe()."""
+
+    TYPE = 0
+
+    def __init__(self, payload: dict[str, Any] | None = None,
+                 data: bytes = b""):
+        self.payload = payload or {}
+        self.data = data
+        # transport fields, stamped by the Connection
+        self.seq = 0
+
+    # -- wire form -----------------------------------------------------------
+
+    def encode_segments(self) -> list[bytes]:
+        header = json.dumps({"type": self.TYPE, "seq": self.seq},
+                            separators=(",", ":")).encode()
+        payload = json.dumps(self.payload, separators=(",", ":"),
+                             sort_keys=True).encode()
+        return [header, payload, self.data]
+
+    @staticmethod
+    def decode_segments(segments: list[bytes]) -> "Message":
+        if len(segments) != 3:
+            raise ValueError(f"message frame has {len(segments)} segments")
+        header = json.loads(segments[0])
+        cls = _REGISTRY.get(header["type"])
+        if cls is None:
+            raise ValueError(f"unknown message type {header['type']}")
+        msg = cls.__new__(cls)
+        Message.__init__(msg, json.loads(segments[1]), segments[2])
+        msg.seq = header["seq"]
+        return msg
+
+    def __repr__(self) -> str:
+        keys = {k: v for k, v in self.payload.items()
+                if not isinstance(v, (list, dict)) or len(str(v)) < 64}
+        return (f"{type(self).__name__}(seq={self.seq}, {keys}, "
+                f"data={len(self.data)}B)")
+
+
+def _simple(type_id: int, name: str):
+    """Define + register a Message subclass with no extra behavior."""
+    cls = type(name, (Message,), {"TYPE": type_id})
+    return register_message(cls)
+
+
+# -- heartbeat / liveness (MOSDPing, src/messages/MOSDPing.h) ----------------
+MPing = _simple(0x10, "MPing")            # payload: {"stamp": float}
+MPingReply = _simple(0x11, "MPingReply")
+
+# -- mon client plane (MMon*, src/messages/MMon*.h) --------------------------
+MMonGetMap = _simple(0x20, "MMonGetMap")          # {"what": "osdmap"|"monmap",
+                                                  #  "have": epoch}
+MMonMap = _simple(0x21, "MMonMap")                # {"monmap": {...}}
+MOSDMapMsg = _simple(0x22, "MOSDMapMsg")          # {"full": {...}|null,
+                                                  #  "incrementals": [...]}
+MMonSubscribe = _simple(0x23, "MMonSubscribe")    # {"what": {"osdmap": start}}
+MMonCommand = _simple(0x24, "MMonCommand")        # {"cmd": {...}, "tid": n}
+MMonCommandAck = _simple(0x25, "MMonCommandAck")  # {"tid", "rc", "out": {...}}
+
+# -- osd control plane -------------------------------------------------------
+MOSDBoot = _simple(0x30, "MOSDBoot")              # {"osd": id, "addr": str}
+MOSDAlive = _simple(0x31, "MOSDAlive")
+MOSDFailure = _simple(0x32, "MOSDFailure")        # {"failed": id, "from": id}
+
+# -- client I/O (MOSDOp/MOSDOpReply, src/messages/MOSDOp.h) ------------------
+MOSDOp = _simple(0x40, "MOSDOp")          # {"tid", "pg": "pool.ps", "oid",
+                                          #  "ops": [{"op": "write"|"read"|...,
+                                          #          "off", "len", ...}],
+                                          #  "epoch": client map epoch}
+MOSDOpReply = _simple(0x41, "MOSDOpReply")  # {"tid", "rc", "out": [...]}
+
+# -- replication (MOSDRepOp, src/messages/MOSDRepOp.h) -----------------------
+MOSDRepOp = _simple(0x50, "MOSDRepOp")            # primary -> replica txn
+MOSDRepOpReply = _simple(0x51, "MOSDRepOpReply")
+
+# -- peering / pg info -------------------------------------------------------
+MOSDPGQuery = _simple(0x60, "MOSDPGQuery")
+MOSDPGInfo = _simple(0x61, "MOSDPGInfo")
+MOSDPGLog = _simple(0x62, "MOSDPGLog")
+MOSDPGPush = _simple(0x63, "MOSDPGPush")          # recovery object push
+MOSDPGPushReply = _simple(0x64, "MOSDPGPushReply")
+
+# -- EC sub-ops (MOSDECSubOpWrite/Read, src/messages/MOSDECSubOp*.h) ---------
+MOSDECSubOpWrite = _simple(0x70, "MOSDECSubOpWrite")
+MOSDECSubOpWriteReply = _simple(0x71, "MOSDECSubOpWriteReply")
+MOSDECSubOpRead = _simple(0x72, "MOSDECSubOpRead")
+MOSDECSubOpReadReply = _simple(0x73, "MOSDECSubOpReadReply")

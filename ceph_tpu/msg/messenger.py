@@ -1,0 +1,499 @@
+"""Messenger: asyncio re-creation of AsyncMessenger + ProtocolV2 sessions.
+
+The reference contract this keeps (src/msg/Messenger.h, ProtocolV2.cc):
+
+  * a Messenger per daemon, bound or client-only, with a dispatcher chain
+    (`ms_dispatch`, `ms_handle_accept/reset/remote_reset`);
+  * Connections with send_message() ordering guarantees and policies —
+    lossy (client->server: a drop loses the session, callers resend at a
+    higher layer, like Objecter) vs lossless peers (osd<->osd: transport
+    faults are invisible; the initiator reconnects and both sides replay
+    messages the other hasn't acked);
+  * session semantics: cookie identifies a session across TCP transports;
+    in_seq/out_seq + ACK frames bound replay; receivers drop duplicates
+    by seq (ProtocolV2 reconnect/replay, out-of-order-safe).
+
+Idiomatic divergences: one asyncio event loop per process instead of
+epoll worker threads; coroutine-per-connection instead of a hand-rolled
+state machine; the banner/HELLO exchange carries JSON instead of
+dencoded structs. Auth is the `none` method only (AuthRegistry slot
+exists conceptually; cephx is out of scope this round).
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import json
+import os
+import time
+from typing import Awaitable, Callable
+
+from ceph_tpu.msg.frames import BANNER, Frame, FrameError, Tag
+from ceph_tpu.msg.messages import Message
+from ceph_tpu.utils.dout import dout
+
+
+class Policy:
+    """Connection policy (Messenger::Policy). lossy: faults reset the
+    session and drop queued messages (callers resend). lossless: faults
+    trigger reconnect+replay; send_message never loses ordering."""
+
+    def __init__(self, lossy: bool):
+        self.lossy = lossy
+
+    @classmethod
+    def lossy_client(cls) -> "Policy":
+        return cls(lossy=True)
+
+    @classmethod
+    def lossless_peer(cls) -> "Policy":
+        return cls(lossy=False)
+
+
+class Dispatcher:
+    """Callback interface (src/msg/Dispatcher.h). Subclass what you need."""
+
+    async def ms_dispatch(self, conn: "Connection", msg: Message) -> bool:
+        """Return True if handled; the chain stops at the first taker."""
+        return False
+
+    def ms_handle_accept(self, conn: "Connection") -> None:
+        pass
+
+    def ms_handle_reset(self, conn: "Connection") -> None:
+        """A lossy session died; queued messages are gone."""
+
+    def ms_handle_remote_reset(self, conn: "Connection") -> None:
+        """Peer declared our session stale (RESET); state was dropped."""
+
+
+class Connection:
+    """One logical session with a peer; survives TCP transports when the
+    policy is lossless. Created by Messenger.connect (initiator) or by an
+    accept (acceptor) — symmetric once established."""
+
+    RECONNECT_BACKOFF = 0.2     # doubles per attempt, capped
+    RECONNECT_BACKOFF_MAX = 5.0
+    ACK_EVERY = 16              # coalesce acks; also acked when idle
+
+    def __init__(self, messenger: "Messenger", peer_addr: tuple[str, int] | None,
+                 policy: Policy, initiator: bool):
+        self.messenger = messenger
+        self.peer_addr = peer_addr          # (host, port) for initiators
+        self.peer_name = ""                 # entity name from HELLO
+        self.policy = policy
+        self.initiator = initiator
+        self.cookie = int.from_bytes(os.urandom(8), "little") if initiator else 0
+
+        self.out_seq = 0                    # last seq stamped
+        self.in_seq = 0                     # last seq delivered
+        self._last_acked_in = 0
+        self._sent: collections.deque[Message] = collections.deque()
+        self._out: asyncio.Queue = asyncio.Queue()
+        self._reader = None
+        self._writer = None
+        self._gen = 0          # transport generation; bumped per _attach
+        self._tasks: list[asyncio.Task] = []
+        self._closed = False
+        self._connected = asyncio.Event()
+
+    # -- public --------------------------------------------------------------
+
+    def send_message(self, msg: Message) -> None:
+        """Queue for ordered delivery. Never blocks; never raises on a
+        down transport (lossless replays, lossy drops on reset)."""
+        if self._closed:
+            return
+        self.out_seq += 1
+        msg.seq = self.out_seq
+        if not self.policy.lossy:
+            self._sent.append(msg)
+        self._out.put_nowait(("msg", msg))
+
+    async def close(self) -> None:
+        self._closed = True
+        tasks = list(self._tasks)   # done-callbacks mutate _tasks
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        await self._close_transport()
+
+    @property
+    def connected(self) -> bool:
+        return self._connected.is_set()
+
+    # -- transport lifecycle -------------------------------------------------
+
+    async def _close_transport(self) -> None:
+        self._connected.clear()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+        self._reader = self._writer = None
+
+    def _attach(self, reader, writer) -> None:
+        self._reader, self._writer = reader, writer
+        self._gen += 1
+        self._connected.set()
+
+    def _spawn(self, coro: Awaitable) -> None:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._tasks.append(task)
+        task.add_done_callback(self._tasks.remove)
+
+    # -- initiator side ------------------------------------------------------
+
+    async def _initiate(self) -> None:
+        """Open the first transport and start the session loops."""
+        await self._open_transport(reconnect=False)
+        self._spawn(self._run())
+
+    async def _open_transport(self, reconnect: bool) -> None:
+        host, port = self.peer_addr
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            await self._handshake(reader, writer, reconnect)
+        except BaseException:
+            writer.close()
+            raise
+
+    async def _handshake(self, reader, writer, reconnect: bool) -> None:
+        writer.write(BANNER)
+        hello = {
+            "entity": self.messenger.entity_name,
+            "cookie": self.cookie,
+            "in_seq": self.in_seq,
+            "reconnect": reconnect,
+            "lossy": self.policy.lossy,
+        }
+        writer.write(Frame(Tag.RECONNECT if reconnect else Tag.HELLO,
+                           [json.dumps(hello).encode()]).encode())
+        await writer.drain()
+        banner = await reader.readexactly(len(BANNER))
+        if banner != BANNER:
+            raise FrameError(f"bad banner {banner!r}")
+        reply = await Frame.read(reader)
+        if reply.tag == Tag.RESET:
+            # Peer lost our session (restart). Re-stamp the unacked tail
+            # into a fresh session IN _sent — not a local — so a failure
+            # of the fresh connect below still retries with the messages
+            # intact. The peer may have seen some of them: delivery
+            # across a session reset is at-least-once and higher layers
+            # dedupe (PG log dup detection, mon command tids).
+            if not reconnect:
+                raise FrameError("RESET in reply to initial HELLO")
+            dout("ms", 1, f"{self} remote reset")
+            self.out_seq = 0
+            for m in self._sent:
+                self.out_seq += 1
+                m.seq = self.out_seq
+            self.in_seq = 0
+            self._last_acked_in = 0
+            self.messenger._notify_remote_reset(self)
+            self.cookie = int.from_bytes(os.urandom(8), "little")
+            writer.close()
+            # fresh session: the HELLO reply's in_seq=0 makes
+            # _requeue_for_replay resend all of _sent
+            await self._open_transport(reconnect=False)
+            return
+        if reply.tag in (Tag.HELLO, Tag.RECONNECT_OK):
+            info = json.loads(reply.segments[0])
+            self.peer_name = info.get("entity", "")
+            self._requeue_for_replay(info.get("in_seq", 0))
+            self._attach(reader, writer)
+            return
+        raise FrameError(f"unexpected handshake tag {reply.tag}")
+
+    def _requeue_for_replay(self, peer_in_seq: int) -> None:
+        """Rebuild the outbound queue for a (re)attached transport: drop
+        everything queued (lossless messages all live in _sent; acks and
+        keepalive replies regenerate) and enqueue the unacked tail in seq
+        order, so replays can never be reordered after newer messages that
+        were queued while the transport was down."""
+        while not self._out.empty():
+            try:
+                self._out.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+        self._trim_sent(peer_in_seq)
+        for m in self._sent:
+            self._out.put_nowait(("msg", m))
+
+    # -- shared session loops ------------------------------------------------
+
+    async def _run(self) -> None:
+        """Session loop: pump the live transport; on fault, lossy sessions
+        die (dispatcher reset callback), lossless initiators reconnect
+        with backoff, lossless acceptors park until the peer's RECONNECT
+        re-attaches a transport."""
+        try:
+            await self._run_inner()
+        finally:
+            self.messenger._forget(self)
+
+    async def _run_inner(self) -> None:
+        backoff = self.RECONNECT_BACKOFF
+        while not self._closed:
+            if not self.connected:
+                if self.policy.lossy:
+                    self.messenger._notify_reset(self)
+                    return
+                if self.initiator:
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, self.RECONNECT_BACKOFF_MAX)
+                    try:
+                        await self._open_transport(reconnect=True)
+                        backoff = self.RECONNECT_BACKOFF
+                    except Exception as e:
+                        dout("ms", 10, f"{self} reconnect failed: {e}")
+                        continue
+                else:
+                    await self._connected.wait()
+                continue
+            gen = self._gen
+            try:
+                await self._pump()
+            except (asyncio.CancelledError, GeneratorExit):
+                return
+            except Exception as e:
+                dout("ms", 5, f"{self} transport fault: {type(e).__name__} {e}")
+            if self._gen == gen:
+                # only tear down the transport the fault belongs to — a
+                # concurrent RECONNECT accept may have attached a new one
+                await self._close_transport()
+
+    async def _pump(self) -> None:
+        reader, writer = self._reader, self._writer
+        reader_task = asyncio.create_task(self._read_loop(reader))
+        writer_task = asyncio.create_task(self._write_loop(writer))
+        try:
+            done, pending = await asyncio.wait(
+                {reader_task, writer_task},
+                return_when=asyncio.FIRST_EXCEPTION)
+        finally:
+            for t in (reader_task, writer_task):
+                t.cancel()
+            for t in (reader_task, writer_task):
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+        for t in done:
+            exc = t.exception()
+            if exc is not None:
+                raise exc
+
+    async def _read_loop(self, reader) -> None:
+        while True:
+            frame = await Frame.read(reader)
+            if frame.tag == Tag.MESSAGE:
+                msg = Message.decode_segments(frame.segments)
+                if msg.seq <= self.in_seq:
+                    continue                      # replayed duplicate
+                self.in_seq = msg.seq
+                await self.messenger._dispatch(self, msg)
+                if self.in_seq - self._last_acked_in >= self.ACK_EVERY:
+                    self._out.put_nowait(("ack", self.in_seq))
+            elif frame.tag == Tag.ACK:
+                (seq,) = json.loads(frame.segments[0])
+                self._trim_sent(seq)
+            elif frame.tag == Tag.KEEPALIVE:
+                self._out.put_nowait(("keepalive_ack", None))
+            elif frame.tag == Tag.KEEPALIVE_ACK:
+                pass
+            else:
+                raise FrameError(f"unexpected tag {frame.tag} mid-session")
+
+    IDLE_ACK_S = 0.5   # flush pending acks when the queue goes quiet
+
+    async def _write_loop(self, writer) -> None:
+        while True:
+            try:
+                item = await asyncio.wait_for(self._out.get(),
+                                              timeout=self.IDLE_ACK_S)
+            except asyncio.TimeoutError:
+                # idle: tell the peer what we've seen so it trims replay
+                if self.in_seq > self._last_acked_in:
+                    item = ("ack", self.in_seq)
+                else:
+                    continue
+            kind, arg = item
+            if kind == "msg":
+                frame = Frame(Tag.MESSAGE, arg.encode_segments())
+            elif kind == "ack":
+                frame = Frame(Tag.ACK, [json.dumps([arg]).encode()])
+                self._last_acked_in = arg
+            elif kind == "keepalive_ack":
+                frame = Frame(Tag.KEEPALIVE_ACK, [])
+            else:  # pragma: no cover
+                continue
+            writer.write(frame.encode())
+            await writer.drain()
+
+    def _trim_sent(self, acked_seq: int) -> None:
+        while self._sent and self._sent[0].seq <= acked_seq:
+            self._sent.popleft()
+
+    def __repr__(self) -> str:
+        return (f"Connection({self.messenger.entity_name}->"
+                f"{self.peer_name or self.peer_addr})")
+
+
+class Messenger:
+    """Endpoint owning connections + dispatcher chain (Messenger::create).
+
+    Usage (daemon):   m = Messenger("osd.1"); m.add_dispatcher(osd);
+                      await m.bind("127.0.0.1", 0); ...
+    Usage (client):   m = Messenger("client.x");
+                      conn = await m.connect(addr, Policy.lossy_client())
+    """
+
+    def __init__(self, entity_name: str):
+        self.entity_name = entity_name
+        self.dispatchers: list[Dispatcher] = []
+        self._server: asyncio.base_events.Server | None = None
+        self.my_addr: tuple[str, int] | None = None
+        self._conns: dict[tuple[str, int], Connection] = {}
+        self._accepted: dict[tuple[str, int], Connection] = {}
+        # acceptor-side sessions by (entity, cookie) for reconnect matching
+        self._sessions: dict[tuple[str, int], Connection] = {}
+        self._connect_locks: dict[tuple[str, int], asyncio.Lock] = {}
+        self._closed = False
+
+    def add_dispatcher(self, d: Dispatcher) -> None:
+        self.dispatchers.append(d)
+
+    # -- server side ---------------------------------------------------------
+
+    async def bind(self, host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._on_accept, host, port)
+        self.my_addr = self._server.sockets[0].getsockname()[:2]
+        dout("ms", 10, f"{self.entity_name} listening on {self.my_addr}")
+        return self.my_addr
+
+    async def _on_accept(self, reader, writer) -> None:
+        try:
+            writer.write(BANNER)
+            banner = await reader.readexactly(len(BANNER))
+            if banner != BANNER:
+                raise FrameError(f"bad banner {banner!r}")
+            frame = await Frame.read(reader)
+            if frame.tag not in (Tag.HELLO, Tag.RECONNECT):
+                raise FrameError(f"bad handshake tag {frame.tag}")
+            info = json.loads(frame.segments[0])
+        except Exception as e:
+            dout("ms", 5, f"{self.entity_name} accept failed: {e}")
+            writer.close()
+            return
+        key = (info.get("entity", "?"), info.get("cookie", 0))
+        peer_in_seq = info.get("in_seq", 0)
+
+        if frame.tag == Tag.RECONNECT:
+            conn = self._sessions.get(key)
+            if conn is None or conn._closed:
+                # stale session: tell the peer to start over
+                writer.write(Frame(Tag.RESET, [b"{}"]).encode())
+                await writer.drain()
+                writer.close()
+                return
+            await conn._close_transport()
+            reply = {"entity": self.entity_name, "in_seq": conn.in_seq}
+            writer.write(Frame(Tag.RECONNECT_OK,
+                               [json.dumps(reply).encode()]).encode())
+            await writer.drain()
+            conn._requeue_for_replay(peer_in_seq)
+            conn._attach(reader, writer)
+            return
+
+        policy = Policy(lossy=bool(info.get("lossy", True)))
+        conn = Connection(self, None, policy, initiator=False)
+        conn.peer_name = info["entity"]
+        conn.cookie = info.get("cookie", 0)
+        reply = {"entity": self.entity_name, "in_seq": 0}
+        writer.write(Frame(Tag.HELLO, [json.dumps(reply).encode()]).encode())
+        await writer.drain()
+        conn._attach(reader, writer)
+        if not policy.lossy:
+            # one lossless session per peer entity: a fresh HELLO from an
+            # entity supersedes any older session (its cookie is gone on
+            # the peer), whose parked _run task would otherwise live forever
+            for old_key, old in list(self._sessions.items()):
+                if old_key[0] == key[0] and old_key != key:
+                    del self._sessions[old_key]
+                    asyncio.get_running_loop().create_task(old.close())
+            self._sessions[key] = conn
+        peer = writer.get_extra_info("peername")
+        if peer:
+            self._accepted[peer[:2]] = conn
+        for d in self.dispatchers:
+            d.ms_handle_accept(conn)
+        conn._spawn(conn._run())
+
+    # -- client side ---------------------------------------------------------
+
+    async def connect(self, addr: tuple[str, int],
+                      policy: Policy | None = None) -> Connection:
+        addr = tuple(addr)
+        lock = self._connect_locks.setdefault(addr, asyncio.Lock())
+        async with lock:   # concurrent first-sends must share one session
+            conn = self._conns.get(addr)
+            if conn is not None and not conn._closed:
+                return conn
+            conn = Connection(self, addr, policy or Policy.lossy_client(),
+                              initiator=True)
+            await conn._initiate()
+            self._conns[addr] = conn
+            return conn
+
+    # -- dispatch ------------------------------------------------------------
+
+    async def _dispatch(self, conn: Connection, msg: Message) -> None:
+        for d in self.dispatchers:
+            try:
+                if await d.ms_dispatch(conn, msg):
+                    return
+            except Exception as e:
+                dout("ms", 0, f"{self.entity_name} dispatcher error on "
+                        f"{msg!r}: {type(e).__name__} {e}")
+                raise
+        dout("ms", 1, f"{self.entity_name} unhandled message {msg!r}")
+
+    def _forget(self, conn: Connection) -> None:
+        """Drop a finished connection from every table (its _run ended)."""
+        for table in (self._conns, self._accepted, self._sessions):
+            for key, c in list(table.items()):
+                if c is conn:
+                    del table[key]
+
+    def _notify_reset(self, conn: Connection) -> None:
+        for d in self.dispatchers:
+            d.ms_handle_reset(conn)
+
+    def _notify_remote_reset(self, conn: Connection) -> None:
+        for d in self.dispatchers:
+            d.ms_handle_remote_reset(conn)
+
+    # -- teardown ------------------------------------------------------------
+
+    async def shutdown(self) -> None:
+        self._closed = True
+        if self._server is not None:
+            self._server.close()
+        # connections first: since 3.12 Server.wait_closed() waits for all
+        # accepted transports, which only die when we close them
+        for conn in list(self._conns.values()) + list(self._accepted.values()) \
+                + list(self._sessions.values()):
+            await conn.close()
+        self._conns.clear()
+        self._accepted.clear()
+        self._sessions.clear()
+        if self._server is not None:
+            await self._server.wait_closed()
