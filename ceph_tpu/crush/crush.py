@@ -218,6 +218,34 @@ class CrushMap:
                 raise ValueError(f"unknown step op {step.op!r}")
         return out[:num_rep] if rule.steps[-1].op == "emit" else out
 
+    # -- wire form (ships inside OSDMap; crushtool-style dump) ---------------
+
+    def to_dict(self) -> dict:
+        return {
+            "buckets": [dataclasses.asdict(b) for b in
+                        sorted(self._buckets.values(), key=lambda b: -b.id)],
+            "rules": [dataclasses.asdict(r) for r in
+                      sorted(self._rules.values(), key=lambda r: r.id)],
+            "names": {name: ref for name, ref in self._names.items()},
+            "type_names": {str(t): n for t, n in self._type_names.items()},
+            "tries": self.tries,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CrushMap":
+        cm = cls()
+        for bd in d["buckets"]:
+            b = Bucket(**bd)
+            cm._buckets[b.id] = b
+            cm._next_bucket_id = min(cm._next_bucket_id, b.id - 1)
+        for rd in d["rules"]:
+            steps = [Step(**s) for s in rd.pop("steps")]
+            cm._rules[rd["id"]] = Rule(steps=steps, **rd)
+        cm._names = dict(d["names"])
+        cm._type_names = {int(t): n for t, n in d["type_names"].items()}
+        cm.tries = d.get("tries", 50)
+        return cm
+
     def _choose_n(self, parent: int, x: int, n: int, step: Step,
                   weights: dict[int, float]) -> list[int]:
         """Pick n items of step.type under parent (crush_choose_{firstn,indep}).

@@ -89,11 +89,17 @@ class Incremental:
     new_osds: dict[int, str] = dataclasses.field(default_factory=dict)
     new_pools: dict[int, Pool] = dataclasses.field(default_factory=dict)
     new_pg_temp: dict[PG, list[int]] = dataclasses.field(default_factory=dict)
+    # full crush dump when the hierarchy changed (the reference also ships
+    # a whole crush blob in Incremental::crush, OSDMap.h) and new/updated
+    # EC profiles (profiles are cluster state living in the OSDMap)
+    new_crush: dict | None = None
+    new_ec_profiles: dict[str, dict] = dataclasses.field(default_factory=dict)
 
     def empty(self) -> bool:
         return not (self.new_up or self.new_down or self.new_in
                     or self.new_out or self.new_weights or self.new_osds
-                    or self.new_pools or self.new_pg_temp)
+                    or self.new_pools or self.new_pg_temp
+                    or self.new_crush or self.new_ec_profiles)
 
     def to_dict(self) -> dict:
         return {
@@ -108,6 +114,8 @@ class Incremental:
                           for p, pool in self.new_pools.items()},
             "new_pg_temp": {str(pg): osds
                             for pg, osds in self.new_pg_temp.items()},
+            "new_crush": self.new_crush,
+            "new_ec_profiles": self.new_ec_profiles,
         }
 
     @classmethod
@@ -125,6 +133,8 @@ class Incremental:
         for key, osds in d.get("new_pg_temp", {}).items():
             pool_s, ps_s = key.split(".")
             inc.new_pg_temp[PG(int(pool_s), int(ps_s, 16))] = list(osds)
+        inc.new_crush = d.get("new_crush")
+        inc.new_ec_profiles = dict(d.get("new_ec_profiles", {}))
         return inc
 
 
@@ -136,6 +146,7 @@ class OSDMap:
         self.pools: dict[int, Pool] = {}
         self.pool_names: dict[str, int] = {}
         self.pg_temp: dict[PG, list[int]] = {}
+        self.ec_profiles: dict[str, dict] = {}
 
     # -- membership ----------------------------------------------------------
 
@@ -253,6 +264,9 @@ class OSDMap:
                 self.pg_temp[pg] = list(osds)
             else:
                 self.pg_temp.pop(pg, None)
+        if inc.new_crush is not None:
+            self.crush = CrushMap.from_dict(inc.new_crush)
+        self.ec_profiles.update(inc.new_ec_profiles)
         self.epoch = inc.epoch
 
     # -- encode/decode (wire form for map distribution) ----------------------
@@ -265,6 +279,8 @@ class OSDMap:
             "pools": {str(p): dataclasses.asdict(pool)
                       for p, pool in self.pools.items()},
             "pg_temp": {str(pg): osds for pg, osds in self.pg_temp.items()},
+            "crush": self.crush.to_dict(),
+            "ec_profiles": self.ec_profiles,
         }
 
     def dumps(self) -> bytes:
@@ -279,3 +295,6 @@ class OSDMap:
         for key, osds in d.get("pg_temp", {}).items():
             pool_s, ps_s = key.split(".")
             self.pg_temp[PG(int(pool_s), int(ps_s, 16))] = osds
+        if d.get("crush") is not None:
+            self.crush = CrushMap.from_dict(d["crush"])
+        self.ec_profiles = dict(d.get("ec_profiles", {}))

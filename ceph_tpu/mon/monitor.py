@@ -1,0 +1,500 @@
+"""Monitor daemon: quorum member + OSDMonitor service + client plane.
+
+Reference shape (src/mon/Monitor.cc, OSDMonitor.cc, PaxosService.cc):
+the monitor owns a Paxos instance; services express state changes as
+pending transactions proposed through it; every quorum member applies
+committed transactions in order, so service state is identical across
+monitors. The OSDMonitor's state is the OSDMap:
+
+  * EC profiles and pools are validated in-monitor by instantiating the
+    erasure-code plugin from the profile (OSDMonitor.cc:7506
+    get_erasure_code; :11260 profile set) — a bad profile never reaches
+    the map;
+  * pool create derives size=k+m / min_size=k+1 from the plugin and
+    builds the CRUSH rule via the EC default (indep, ErasureCode.cc:70);
+  * osd boots (MOSDBoot) add the osd under its crush_location and mark
+    it up; failure reports (MOSDFailure) mark it down once enough
+    distinct reporters agree (OSDMonitor.cc:2868 reporter quorum); a
+    leader tick marks long-down osds out (down_out_interval);
+  * committed epochs are pushed to osdmap subscribers as incrementals.
+
+Peons forward osd-plane messages to the leader and bounce commands with
+a leader hint (the reference forwards those too; the client retry keeps
+this simpler without changing observable behavior).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from ceph_tpu.crush import CrushMap, Incremental, OSDMap, Pool, Rule, Step
+from ceph_tpu.mon.paxos import Paxos
+from ceph_tpu.mon.store import MonStore, MonStoreTxn
+from ceph_tpu.msg.messages import (Message, MMonCommand, MMonCommandAck,
+                                   MMonElection, MMonGetMap, MMonMap,
+                                   MMonPaxos, MMonSubscribe, MOSDBoot,
+                                   MOSDFailure, MOSDMapMsg, MPing, MPingReply)
+from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
+from ceph_tpu.utils.dout import dout
+
+
+class MonMap:
+    """Names -> addrs; rank = index in sorted names (src/mon/MonMap.h)."""
+
+    def __init__(self, mons: dict[str, tuple[str, int]], epoch: int = 1):
+        self.epoch = epoch
+        self.mons = {name: tuple(addr) for name, addr in mons.items()}
+
+    @property
+    def ranks(self) -> list[str]:
+        return sorted(self.mons)
+
+    def rank_of(self, name: str) -> int:
+        return self.ranks.index(name)
+
+    def addr_of_rank(self, rank: int) -> tuple[str, int]:
+        return self.mons[self.ranks[rank]]
+
+    def to_dict(self) -> dict:
+        return {"epoch": self.epoch,
+                "mons": {n: list(a) for n, a in self.mons.items()}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MonMap":
+        return cls({n: tuple(a) for n, a in d["mons"].items()}, d["epoch"])
+
+
+class OSDMonitor:
+    """The OSDMap service (src/mon/OSDMonitor.cc essentials)."""
+
+    MIN_DOWN_REPORTERS = 1
+    DOWN_OUT_INTERVAL = 30.0
+
+    def __init__(self, mon: "Monitor"):
+        self.mon = mon
+        self.osdmap = OSDMap(CrushMap())
+        self.pending: Incremental | None = None
+        self.down_at: dict[int, float] = {}
+        # failed osd -> set of reporter osds (reporter quorum)
+        self.failure_reports: dict[int, set[int]] = {}
+
+    # -- state recovery ------------------------------------------------------
+
+    def load(self) -> None:
+        store = self.mon.store
+        epochs = [int(e) for e in store.keys("osdmap_full")]
+        if epochs:
+            latest = max(epochs)
+            self.osdmap.load_dict(store.get("osdmap_full", str(latest)))
+
+    # -- pending / propose ---------------------------------------------------
+
+    def get_pending(self) -> Incremental:
+        if self.pending is None:
+            self.pending = Incremental(epoch=self.osdmap.epoch + 1)
+        return self.pending
+
+    def encode_pending(self) -> bytes:
+        inc = self.pending
+        self.pending = None
+        return json.dumps({"service": "osdmap",
+                           "inc": inc.to_dict()}).encode()
+
+    async def propose_pending(self) -> int | None:
+        """Propose the pending incremental; resolves at commit."""
+        if self.pending is None or self.pending.empty():
+            self.pending = None
+            return None
+        value = self.encode_pending()
+        fut = self.mon.paxos.propose(value)
+        return await asyncio.wait_for(fut, 30)
+
+    def apply_commit(self, inc_dict: dict, txn: MonStoreTxn) -> None:
+        inc = Incremental.from_dict(inc_dict)
+        if inc.epoch != self.osdmap.epoch + 1:
+            dout("mon", 10, f"{self.mon.name}: skip stale inc "
+                            f"{inc.epoch} at {self.osdmap.epoch}")
+            return
+        self.osdmap.apply_incremental(inc)
+        for osd in inc.new_down:
+            self.down_at[osd] = time.monotonic()
+            self.failure_reports.pop(osd, None)
+        for osd in inc.new_up:
+            self.down_at.pop(osd, None)
+            self.failure_reports.pop(osd, None)
+        txn.put("osdmap_full", str(self.osdmap.epoch), self.osdmap.to_dict())
+        txn.put("osdmap_inc", str(inc.epoch), inc_dict)
+        self.mon.kick_subscribers()
+
+    # -- control-plane verbs -------------------------------------------------
+
+    def _get_erasure_code(self, profile_name: str):
+        """Instantiate the plugin from a stored profile — in-monitor
+        validation (OSDMonitor.cc:7506)."""
+        from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+        profile = self.osdmap.ec_profiles.get(profile_name)
+        if profile is None:
+            raise ValueError(f"erasure-code profile {profile_name!r} "
+                             "does not exist")
+        plugin = profile.get("plugin", "jerasure")
+        return ErasureCodePluginRegistry.instance().factory(
+            plugin, dict(profile))
+
+    def cmd_profile_set(self, name: str, profile: dict) -> dict:
+        from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+        plugin = profile.get("plugin", "jerasure")
+        # validate by instantiation before it can enter the map
+        ErasureCodePluginRegistry.instance().factory(plugin, dict(profile))
+        self.get_pending().new_ec_profiles[name] = dict(profile)
+        return {"profile": name}
+
+    def _ensure_root(self, crush: CrushMap) -> None:
+        if "default" not in crush._names:
+            crush.add_bucket(10, "default")
+
+    def _next_rule_id(self, crush: CrushMap) -> int:
+        return max(crush._rules, default=-1) + 1
+
+    def cmd_pool_create(self, name: str, pg_num: int = 32,
+                        pool_type: str = "replicated", size: int = 3,
+                        erasure_code_profile: str = "",
+                        crush_failure_domain: int = 1) -> dict:
+        if name in self.osdmap.pool_names:
+            raise ValueError(f"pool {name!r} exists")
+        crush = CrushMap.from_dict(self.osdmap.crush.to_dict())
+        self._ensure_root(crush)
+        rule_id = self._next_rule_id(crush)
+        if pool_type == "erasure":
+            ec = self._get_erasure_code(erasure_code_profile)
+            k = ec.get_data_chunk_count()
+            m = ec.get_chunk_count() - k
+            size = k + m
+            min_size = k + 1
+            # EC rule: indep with holes (ErasureCode::create_rule, mode
+            # "indep"; OSDMonitor crush_rule_create_erasure :7470)
+            crush.make_simple_rule(rule_id, f"{name}_rule", "default",
+                                   crush_failure_domain, mode="indep")
+            stripe_width = k * 4096
+        else:
+            min_size = max(1, size - 1)
+            crush.make_simple_rule(rule_id, f"{name}_rule", "default",
+                                   crush_failure_domain, mode="firstn")
+            stripe_width = 0
+        pid = max(self.osdmap.pools, default=0) + 1
+        pending = self.get_pending()
+        for other in pending.new_pools.values():
+            if other.name == name:
+                raise ValueError(f"pool {name!r} pending")
+            pid = max(pid, other.id + 1)
+        pending.new_pools[pid] = Pool(
+            id=pid, name=name, type=pool_type, size=size, min_size=min_size,
+            pg_num=pg_num, crush_rule=rule_id,
+            ec_profile=erasure_code_profile, stripe_width=stripe_width)
+        pending.new_crush = crush.to_dict()
+        return {"pool": name, "pool_id": pid, "size": size,
+                "min_size": min_size, "crush_rule": rule_id}
+
+    def handle_boot(self, payload: dict) -> bool:
+        """MOSDBoot: add under crush_location, mark up. True if changed."""
+        osd = payload["osd"]
+        addr = payload["addr"]
+        loc = payload.get("crush_location", {})
+        weight = payload.get("weight", 1.0)
+        state = self.osdmap.osds.get(osd)
+        pending = self.get_pending()
+        if state is None or osd not in [i for b in
+                                        self.osdmap.crush._buckets.values()
+                                        for i in b.items]:
+            crush = CrushMap.from_dict(self.osdmap.crush.to_dict())
+            self._ensure_root(crush)
+            host = loc.get("host", f"host{osd}")
+            if host not in crush._names:
+                crush.add_bucket(1, host)
+                crush.add_item("default", crush._names[host], 0.0)
+            bid = crush._names[host]
+            bucket = crush._buckets[bid]
+            if osd not in bucket.items:
+                crush.add_item(bid, osd, weight, name=f"osd.{osd}")
+                # bump the host's weight in the root by the osd weight
+                root = crush._buckets[crush._names["default"]]
+                idx = root.items.index(bid)
+                root.weights[idx] += weight
+            pending.new_crush = crush.to_dict()
+        if state is None:
+            pending.new_osds[osd] = addr
+        if state is None or not state.up or state.addr != addr:
+            pending.new_up[osd] = addr
+            if state is not None and not state.in_cluster:
+                pending.new_in.append(osd)
+            return True
+        return not pending.empty()
+
+    def handle_failure(self, payload: dict) -> bool:
+        failed = payload["failed"]
+        reporter = payload.get("from", -1)
+        state = self.osdmap.osds.get(failed)
+        if state is None or not state.up:
+            return False
+        reporters = self.failure_reports.setdefault(failed, set())
+        reporters.add(reporter)
+        if len(reporters) >= self.MIN_DOWN_REPORTERS:
+            pending = self.get_pending()
+            if failed not in pending.new_down:
+                pending.new_down.append(failed)
+            return True
+        return False
+
+    def tick(self) -> bool:
+        """Leader periodic work: down -> out after the interval."""
+        changed = False
+        now = time.monotonic()
+        for osd, when in list(self.down_at.items()):
+            state = self.osdmap.osds.get(osd)
+            if state is None or state.up:
+                continue
+            if state.in_cluster and now - when > self.DOWN_OUT_INTERVAL:
+                pending = self.get_pending()
+                if osd not in pending.new_out:
+                    pending.new_out.append(osd)
+                    changed = True
+        return changed
+
+
+class Monitor(Dispatcher):
+    """One monitor daemon: messenger + paxos + services + client plane."""
+
+    def __init__(self, name: str, monmap: MonMap,
+                 store_path: str | None = None):
+        self.name = name
+        self.monmap = monmap
+        self.rank = monmap.rank_of(name)
+        self.store = MonStore(store_path)
+        self.messenger = Messenger(f"mon.{name}")
+        self.messenger.add_dispatcher(self)
+        peers = {monmap.rank_of(n): addr for n, addr in monmap.mons.items()
+                 if n != name}
+        self.paxos = Paxos(self.messenger, self.rank, peers, self.store,
+                           on_commit=self._on_paxos_commit,
+                           on_role_change=self._on_role_change)
+        self.osdmon = OSDMonitor(self)
+        # osdmap subscribers: conn -> next epoch wanted
+        self.subs: dict[Connection, int] = {}
+        self._tick_task: asyncio.Task | None = None
+        self._applied = 0      # last paxos version applied to services
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> tuple[str, int]:
+        addr = await self.messenger.bind(*self.monmap.mons[self.name])
+        self.osdmon.load()
+        self._applied = self.store.get("mon", "applied_version", 0)
+        self.paxos.recover_from_store()
+        self._replay_missing()
+        await self.paxos.start()
+        self._tick_task = asyncio.get_running_loop().create_task(self._tick())
+        dout("mon", 1, f"mon.{self.name} up at {addr} rank {self.rank}")
+        return addr
+
+    async def stop(self) -> None:
+        if self._tick_task:
+            self._tick_task.cancel()
+            try:
+                await self._tick_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.paxos.stop()
+        await self.messenger.shutdown()
+
+    def _replay_missing(self) -> None:
+        """Apply any paxos values committed but not yet service-applied
+        (crash between paxos txn and service txn)."""
+        for v in range(self._applied + 1, self.paxos.last_committed + 1):
+            raw = self.store.get("paxos_values", str(v))
+            if raw is not None:
+                self._apply_value(v, raw.encode("latin1"))
+
+    async def _tick(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            if self.paxos.is_leader() and self.paxos.is_active():
+                if self.osdmon.tick():
+                    await self.osdmon.propose_pending()
+
+    # -- paxos plumbing ------------------------------------------------------
+
+    def _on_paxos_commit(self, version: int, value: bytes) -> None:
+        self._apply_value(version, value)
+
+    def _apply_value(self, version: int, value: bytes) -> None:
+        txn = MonStoreTxn()
+        try:
+            decoded = json.loads(value)
+            if decoded.get("service") == "osdmap":
+                self.osdmon.apply_commit(decoded["inc"], txn)
+        except Exception as e:
+            dout("mon", 0, f"mon.{self.name}: apply v{version} failed: "
+                           f"{type(e).__name__} {e}")
+        self._applied = version
+        txn.put("mon", "applied_version", version)
+        self.store.apply_transaction(txn)
+
+    def _on_role_change(self) -> None:
+        if self.paxos.is_leader() and self.osdmon.osdmap.epoch == 0:
+            # first leader seeds the initial map (epoch 1: empty crush root)
+            crush = CrushMap()
+            crush.add_bucket(10, "default")
+            inc = self.osdmon.get_pending()
+            inc.new_crush = crush.to_dict()
+            asyncio.get_running_loop().create_task(
+                self.osdmon.propose_pending())
+
+    # -- dispatch ------------------------------------------------------------
+
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, MMonElection):
+            await self.paxos.handle_election(conn, msg)
+        elif isinstance(msg, MMonPaxos):
+            await self.paxos.handle_paxos(conn, msg)
+        elif isinstance(msg, MPing):
+            conn.send_message(MPingReply(dict(msg.payload)))
+        elif isinstance(msg, MMonGetMap):
+            self._handle_get_map(conn, msg)
+        elif isinstance(msg, MMonSubscribe):
+            self._handle_subscribe(conn, msg)
+        elif isinstance(msg, MMonCommand):
+            await self._handle_command(conn, msg)
+        elif isinstance(msg, MOSDBoot):
+            await self._osd_plane(msg, self.osdmon.handle_boot)
+        elif isinstance(msg, MOSDFailure):
+            await self._osd_plane(msg, self.osdmon.handle_failure)
+        else:
+            return False
+        return True
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        self.subs.pop(conn, None)
+
+    # -- client plane --------------------------------------------------------
+
+    def _handle_get_map(self, conn: Connection, msg: MMonGetMap) -> None:
+        what = msg.payload.get("what", "monmap")
+        if what == "monmap":
+            conn.send_message(MMonMap({"monmap": self.monmap.to_dict()}))
+        else:
+            osdmap = self.osdmon.osdmap
+            conn.send_message(MOSDMapMsg(
+                {"full": osdmap.to_dict() if osdmap.epoch else None,
+                 "incrementals": []}))
+
+    def _handle_subscribe(self, conn: Connection, msg: MMonSubscribe) -> None:
+        want = msg.payload.get("what", {})
+        if "osdmap" in want:
+            start = int(want["osdmap"])
+            self.subs[conn] = start
+            self._push_maps(conn)
+
+    def kick_subscribers(self) -> None:
+        for conn in list(self.subs):
+            self._push_maps(conn)
+
+    def _push_maps(self, conn: Connection) -> None:
+        start = self.subs.get(conn, 0)
+        cur = self.osdmon.osdmap.epoch
+        if start > cur:
+            return
+        incs = []
+        for e in range(max(start, 1), cur + 1):
+            inc = self.store.get("osdmap_inc", str(e))
+            if inc is None:
+                incs = None
+                break
+            incs.append(inc)
+        if incs is not None and incs and start >= 1:
+            conn.send_message(MOSDMapMsg({"full": None,
+                                          "incrementals": incs}))
+        else:
+            conn.send_message(MOSDMapMsg(
+                {"full": self.osdmon.osdmap.to_dict(), "incrementals": []}))
+        self.subs[conn] = cur + 1
+
+    async def _osd_plane(self, msg: Message, handler) -> None:
+        if not self.paxos.is_leader():
+            leader = self.paxos.leader
+            if leader is not None and leader != self.rank:
+                await self.paxos._send(leader, type(msg)(dict(msg.payload),
+                                                         msg.data))
+            return
+        if handler(msg.payload):
+            await self.osdmon.propose_pending()
+
+    async def _handle_command(self, conn: Connection, msg: MMonCommand) -> None:
+        tid = msg.payload.get("tid", 0)
+        cmd = msg.payload.get("cmd", {})
+        prefix = cmd.get("prefix", "")
+        read_only = prefix in ("mon stat", "osd dump", "osd tree",
+                               "osd erasure-code-profile ls",
+                               "osd erasure-code-profile get")
+        if not read_only and not (self.paxos.is_leader()
+                                  and self.paxos.is_active()):
+            leader = self.paxos.leader
+            leader_name = (self.monmap.ranks[leader]
+                           if leader is not None else None)
+            conn.send_message(MMonCommandAck(
+                {"tid": tid, "rc": -11,
+                 "error": "not leader",
+                 "leader": leader_name,
+                 "leader_addr": list(self.monmap.addr_of_rank(leader))
+                 if leader is not None else None}))
+            return
+        try:
+            out = await self._run_command(prefix, cmd)
+            conn.send_message(MMonCommandAck({"tid": tid, "rc": 0,
+                                              "out": out}))
+        except Exception as e:
+            conn.send_message(MMonCommandAck(
+                {"tid": tid, "rc": -22,
+                 "error": f"{type(e).__name__}: {e}"}))
+
+    async def _run_command(self, prefix: str, cmd: dict) -> dict:
+        om = self.osdmon
+        if prefix == "mon stat":
+            return {"name": self.name, "rank": self.rank,
+                    "leader": self.paxos.leader,
+                    "quorum": sorted(self.paxos.quorum),
+                    "election_epoch": self.paxos.epoch}
+        if prefix == "osd dump":
+            return om.osdmap.to_dict()
+        if prefix == "osd tree":
+            crush = om.osdmap.crush
+            return {"buckets": {b.name: {"type": b.type,
+                                         "items": list(b.items),
+                                         "weights": list(b.weights)}
+                                for b in crush._buckets.values()}}
+        if prefix == "osd erasure-code-profile ls":
+            return {"profiles": sorted(om.osdmap.ec_profiles)}
+        if prefix == "osd erasure-code-profile get":
+            name = cmd["name"]
+            return {"profile": om.osdmap.ec_profiles[name]}
+        if prefix == "osd erasure-code-profile set":
+            out = om.cmd_profile_set(cmd["name"], cmd.get("profile", {}))
+            await om.propose_pending()
+            return out
+        if prefix == "osd pool create":
+            out = om.cmd_pool_create(
+                cmd["pool"], pg_num=int(cmd.get("pg_num", 32)),
+                pool_type=cmd.get("pool_type", "replicated"),
+                size=int(cmd.get("size", 3)),
+                erasure_code_profile=cmd.get("erasure_code_profile", ""),
+                crush_failure_domain=int(cmd.get("crush_failure_domain", 1)))
+            await om.propose_pending()
+            return out
+        if prefix in ("osd out", "osd in", "osd down"):
+            ids = [int(i) for i in cmd.get("ids", [])]
+            pending = om.get_pending()
+            for osd in ids:
+                {"osd out": pending.new_out, "osd down": pending.new_down,
+                 "osd in": pending.new_in}[prefix].append(osd)
+            await om.propose_pending()
+            return {"ids": ids}
+        raise ValueError(f"unknown command {prefix!r}")

@@ -90,6 +90,14 @@ MMonSubscribe = _simple(0x23, "MMonSubscribe")    # {"what": {"osdmap": start}}
 MMonCommand = _simple(0x24, "MMonCommand")        # {"cmd": {...}, "tid": n}
 MMonCommandAck = _simple(0x25, "MMonCommandAck")  # {"tid", "rc", "out": {...}}
 
+# -- mon<->mon quorum plane (MMonElection.h, MMonPaxos.h) --------------------
+MMonElection = _simple(0x26, "MMonElection")      # {"op": propose|ack|victory,
+                                                  #  "epoch", "rank"}
+MMonPaxos = _simple(0x27, "MMonPaxos")            # {"op": collect|last|begin|
+                                                  #  accept|commit|lease|...,
+                                                  #  "pn", "version", ...};
+                                                  # value rides the data seg
+
 # -- osd control plane -------------------------------------------------------
 MOSDBoot = _simple(0x30, "MOSDBoot")              # {"osd": id, "addr": str}
 MOSDAlive = _simple(0x31, "MOSDAlive")
