@@ -32,6 +32,10 @@ from ceph_tpu.msg.messenger import Connection, Messenger, Policy
 from ceph_tpu.utils.dout import dout
 
 
+class NotLeader(Exception):
+    """A proposal was made by (or survived into) a non-leader."""
+
+
 class Paxos:
     ELECTION_TIMEOUT = 0.35     # victory claim after silence from betters
     LEASE_INTERVAL = 0.8        # leader re-extends this often
@@ -48,6 +52,7 @@ class Paxos:
         self.store = store
         self.on_commit = on_commit             # (version, value) in order
         self.on_role_change = on_role_change or (lambda: None)
+        self.on_sync: Callable[[], None] | None = None  # after sync_full
 
         # durable state
         self.last_pn = store.get("paxos", "last_pn", 0)
@@ -68,15 +73,15 @@ class Paxos:
         self._inflight: asyncio.Future | None = None
         self._lease_expiry = 0.0
         self._active = False
-        self._tasks: list[asyncio.Task] = []
+        self._tasks: set[asyncio.Task] = set()
         self._started = False
 
     # ------------------------------------------------------------------ util
 
     def _spawn(self, coro) -> None:
         t = asyncio.get_running_loop().create_task(coro)
-        self._tasks.append(t)
-        t.add_done_callback(self._tasks.remove)
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
 
     async def _send(self, rank: int, msg) -> None:
         try:
@@ -123,7 +128,23 @@ class Paxos:
             await asyncio.sleep(self.LEASE_INTERVAL / 2)
             now = time.monotonic()
             if self.role == "leader" and self._active:
-                self._extend_lease()
+                if self.uncommitted is not None and \
+                        now > self._accept_deadline:
+                    # a quorum member died mid-proposal: accept acks will
+                    # never arrive; bounce into an election so the quorum
+                    # shrinks to the live set (Paxos.cc accept timeout)
+                    dout("paxos", 5, f"mon.{self.rank}: accept timeout, "
+                                     "electing")
+                    self.start_election()
+                else:
+                    self._extend_lease()
+            elif self.role == "leader" and not self._active and \
+                    now > self._accept_deadline:
+                # collect phase stalled the same way (dead quorum member
+                # between victory and active): re-elect with the live set
+                dout("paxos", 5, f"mon.{self.rank}: collect timeout, "
+                                 "electing")
+                self.start_election()
             elif self.role == "peon" and now > self._lease_expiry:
                 dout("paxos", 5, f"mon.{self.rank}: lease expired, electing")
                 self.start_election()
@@ -190,14 +211,22 @@ class Paxos:
             else:
                 # we outrank them: push our own candidacy
                 if self.role in ("leader", "peon") and self._active and \
-                        self.leader is not None and self.leader < peer_rank:
-                    # stable quorum under a better leader; re-assert it
+                        self.leader is not None and self.leader < peer_rank \
+                        and peer_rank in self.quorum:
+                    # stable quorum under a better leader and the proposer
+                    # is already a member (a duplicate/late propose);
+                    # re-assert it
                     if self.is_leader():
                         self._broadcast(lambda: MMonElection(
                             {"op": "victory", "epoch": self.epoch,
                              "rank": self.rank,
                              "quorum": sorted(self.quorum)}))
                 else:
+                    # a rank OUTSIDE the quorum proposing means a mon
+                    # booted/rejoined: run a full election so the quorum
+                    # grows to include it (the reference joins every
+                    # propose; re-asserting the stale quorum would lock
+                    # the newcomer out forever — ADVICE r3)
                     self.start_election()
         elif op == "ack":
             if self.role == "electing" and peer_epoch == self.epoch:
@@ -210,6 +239,7 @@ class Paxos:
                 self.leader = peer_rank
                 self.quorum = set(msg.payload.get("quorum", []))
                 self._lease_expiry = time.monotonic() + self.LEASE_TIMEOUT
+                self._fail_proposals("lost leadership")
                 self.on_role_change()
             else:
                 self.start_election()   # a worse rank claims victory: contest
@@ -230,6 +260,7 @@ class Paxos:
         self.accepted_pn = pn
         self.store.put_one("paxos", "accepted_pn", pn)
         self._collect_acks = {self.rank}
+        self._accept_deadline = time.monotonic() + self.ACCEPT_TIMEOUT
         if self.uncommitted and self.uncommitted[1] == self.last_committed + 1:
             self._pending_value = self.uncommitted[2]
         for r in sorted(self.quorum - {self.rank}):
@@ -250,6 +281,16 @@ class Paxos:
                 self._pending_value = None
                 self._begin(value)
             else:
+                if self._inflight is not None:
+                    # we re-won an election but the value we had in flight
+                    # wasn't carried into this round (it either committed
+                    # through a share or is gone): its outcome is unknown,
+                    # so fail the waiter — callers retry and the service
+                    # layer dedupes stale epochs
+                    if not self._inflight.done():
+                        self._inflight.set_exception(NotLeader(
+                            "proposal outcome unknown after re-election"))
+                    self._inflight = None
                 self._kick_queue()
 
     # --------------------------------------------------------- begin/commit
@@ -258,9 +299,24 @@ class Paxos:
         """Queue a value; resolves with its committed version (leader only;
         callers check is_leader)."""
         fut = asyncio.get_running_loop().create_future()
+        if self.role != "leader":
+            fut.set_exception(NotLeader(f"mon.{self.rank} is {self.role}"))
+            return fut
         self._proposal_queue.append((value, fut))
         self._kick_queue()
         return fut
+
+    def _fail_proposals(self, why: str) -> None:
+        """Fail queued/in-flight proposal futures (leadership lost). The
+        in-flight value may still commit through the new leader's collect;
+        callers dedupe via service-level stale-epoch skip."""
+        for _, fut in self._proposal_queue:
+            if not fut.done():
+                fut.set_exception(NotLeader(why))
+        self._proposal_queue.clear()
+        if self._inflight is not None and not self._inflight.done():
+            self._inflight.set_exception(NotLeader(why))
+        self._inflight = None
 
     def _kick_queue(self) -> None:
         if (self.role == "leader" and self._active
@@ -299,12 +355,22 @@ class Paxos:
             self._inflight = None
             self._kick_queue()
 
+    KEEP_VERSIONS = 256   # paxos trim window (mon_max_log_entries analog)
+
     def _commit(self, version: int, value: bytes) -> None:
         from ceph_tpu.mon.store import MonStoreTxn
         txn = MonStoreTxn()
         txn.put("paxos_values", str(version), value.decode("latin1"))
         txn.put("paxos", "last_committed", version)
         txn.erase("paxos", "uncommitted")
+        # trim: keep a bounded version window (reference Paxos::trim) so
+        # the store stays O(live state), not O(history)
+        first = self.store.get("paxos", "first_committed", 1)
+        new_first = version - self.KEEP_VERSIONS + 1
+        if new_first > first:
+            for v in range(first, new_first):
+                txn.erase("paxos_values", str(v))
+            txn.put("paxos", "first_committed", new_first)
         self.store.apply_transaction(txn)
         self.last_committed = version
         self.uncommitted = None
@@ -323,23 +389,31 @@ class Paxos:
             pn = msg.payload["pn"]
             reply = {"op": "last", "pn": pn, "rank": self.rank,
                      "last_committed": self.last_committed}
+            data = b""
             if pn > self.accepted_pn:
                 self.accepted_pn = pn
                 self.store.put_one("paxos", "accepted_pn", pn)
                 if self.uncommitted:
                     reply["uncommitted_pn"] = self.uncommitted[0]
                     reply["uncommitted_version"] = self.uncommitted[1]
-                    conn.send_message(MMonPaxos(reply, self.uncommitted[2]))
-                    return
-            else:
-                reply["op"] = "last"    # stale pn: still answer with state
-            # share newer commits with a lagging leader
+                    data = self.uncommitted[2]
+            # share newer commits with a lagging leader regardless of
+            # whether we also hold an uncommitted value (Paxos share_state)
             leader_lc = msg.payload.get("last_committed", 0)
             if self.last_committed > leader_lc:
+                first = self.store.get("paxos", "first_committed", 1)
+                if leader_lc + 1 < first:
+                    # the LEADER is behind our trim horizon (it restarted
+                    # after a long outage and won on rank): a gappy share
+                    # would apply nothing; hand it the whole store instead
+                    conn.send_message(MMonPaxos(
+                        {"op": "sync_full", "store": self.store.dump(),
+                         "last_committed": self.last_committed}))
+                    return
                 share = self._values_since(leader_lc)
                 reply["share"] = [[v, val.decode("latin1")]
                                   for v, val in share]
-            conn.send_message(MMonPaxos(reply))
+            conn.send_message(MMonPaxos(reply, data))
         elif op == "last":
             if self.role != "leader":
                 return
@@ -351,8 +425,43 @@ class Paxos:
             if msg.payload.get("uncommitted_version") == \
                     self.last_committed + 1 and msg.data:
                 self._pending_value = msg.data
+            # catch a lagging peon up BEFORE counting it into the quorum:
+            # ordered lossless delivery means these commits land before
+            # any later begin, so the peon can accept version lc+1
+            # (Paxos::share_state — the r3 'lagging peon rejects every
+            # begin' wedge)
+            peer_lc = msg.payload.get("last_committed", 0)
+            if peer_lc < self.last_committed:
+                first = self.store.get("paxos", "first_committed", 1)
+                if peer_lc + 1 < first:
+                    # beyond our trim horizon: full store sync
+                    conn.send_message(MMonPaxos(
+                        {"op": "sync_full",
+                         "store": self.store.dump(),
+                         "last_committed": self.last_committed}))
+                else:
+                    for v, val in self._values_since(peer_lc):
+                        conn.send_message(MMonPaxos(
+                            {"op": "commit", "version": v}, val))
             self._collect_acks.add(peer)
             self._maybe_collect_done()
+        elif op == "sync_full":
+            # we are hopelessly behind (restarted past the peer's trim
+            # horizon): adopt the peer's whole store (Monitor sync). This
+            # runs on a behind peon (leader caught us up) or on a behind
+            # LEADER (a peon refused a gappy share) — a leader re-collects
+            # with its recovered state so begins line up with the quorum.
+            if msg.payload.get("last_committed", 0) <= self.last_committed:
+                return      # stale/duplicate sync
+            self.store.load_dump(msg.payload["store"])
+            self.last_committed = self.store.get("paxos",
+                                                 "last_committed", 0)
+            self.accepted_pn = self.store.get("paxos", "accepted_pn", 0)
+            self.uncommitted = None
+            if self.on_sync is not None:
+                self.on_sync()
+            if self.role == "leader":
+                self._collect()
         elif op == "begin":
             pn = msg.payload["pn"]
             version = msg.payload["version"]

@@ -60,6 +60,19 @@ class MonStore:
         txn.put(prefix, key, value)
         self.apply_transaction(txn)
 
+    # -- full sync (Monitor store sync for hopelessly-behind peers) ----------
+
+    def dump(self) -> dict:
+        return json.loads(json.dumps(self._data))   # deep, JSON-safe copy
+
+    def load_dump(self, data: dict) -> None:
+        self._data = data
+        self._persist()
+
+    def size_bytes(self) -> int:
+        """Serialized size — used by the bounded-growth test."""
+        return len(json.dumps(self._data))
+
     def _persist(self) -> None:
         if not self.path:
             return
