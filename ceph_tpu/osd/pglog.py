@@ -1,0 +1,143 @@
+"""PGLog: per-PG ordered op log with missing-set tracking.
+
+Re-creation of the reference's PGLog essentials (src/osd/PGLog.{h,cc},
+pg_log_entry_t at src/osd/osd_types.h:4325): every write appends an
+entry stamped with an eversion (map epoch, per-PG sequence); peers
+compare logs during peering, divergent entries are rewound, and the
+objects whose entries one side lacks become its *missing set*, repaired
+by log-driven recovery (push of the authoritative object) instead of a
+full resync (PGLog::merge_log, src/osd/PGLog.h:1254).
+
+Idiomatic divergences: entries are JSON-able dataclasses; rollback is
+whole-object re-push (the reference's per-op rollback info is an
+optimization on top of the same authority rules); the log is bounded by
+entry count, with a fallthrough to backfill when a peer is behind the
+tail.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+Eversion = tuple[int, int]      # (epoch, seq) — totally ordered
+ZERO: Eversion = (0, 0)
+
+
+@dataclasses.dataclass
+class LogEntry:
+    """pg_log_entry_t-lite: what happened to which object, when."""
+
+    version: Eversion
+    op: str                     # "modify" | "delete"
+    oid: str                    # object name within the PG
+    prior_version: Eversion = ZERO
+
+    def to_dict(self) -> dict:
+        return {"version": list(self.version), "op": self.op,
+                "oid": self.oid, "prior_version": list(self.prior_version)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LogEntry":
+        return cls(version=tuple(d["version"]), op=d["op"], oid=d["oid"],
+                   prior_version=tuple(d.get("prior_version", [0, 0])))
+
+
+class PGLog:
+    """Bounded ordered log + missing set (PGLog.h)."""
+
+    MAX_ENTRIES = 1000          # osd_max_pg_log_entries analog
+
+    def __init__(self):
+        self.entries: list[LogEntry] = []
+        self.tail: Eversion = ZERO      # everything <= tail is implicit
+        self.head: Eversion = ZERO      # last_update
+        # oid -> (need version, have prior) — objects this replica must
+        # recover before it can serve them (pg_missing_t)
+        self.missing: dict[str, Eversion] = {}
+
+    # -- append path ---------------------------------------------------------
+
+    def append(self, entry: LogEntry) -> None:
+        assert entry.version > self.head, (entry, self.head)
+        self.entries.append(entry)
+        self.head = entry.version
+        if len(self.entries) > self.MAX_ENTRIES:
+            drop = len(self.entries) - self.MAX_ENTRIES
+            self.tail = self.entries[drop - 1].version
+            del self.entries[:drop]
+
+    # -- peering -------------------------------------------------------------
+
+    def entries_since(self, since: Eversion) -> list[LogEntry] | None:
+        """Entries with version > since, or None if `since` predates the
+        tail (log too short -> caller must backfill)."""
+        if since < self.tail:
+            return None
+        return [e for e in self.entries if e.version > since]
+
+    def merge_log(self, auth_entries: Iterable[LogEntry],
+                  auth_head: Eversion) -> dict[str, Eversion]:
+        """Adopt the authoritative log (PGLog::merge_log semantics):
+
+        * entries we lack (version > our head) are applied to the log and
+          their objects become missing (to be pushed);
+        * our entries PAST the authoritative head are divergent (we
+          accepted writes the quorum never finished): the touched objects
+          must be rewound to the authoritative version -> also missing.
+
+        Returns the resulting missing map (oid -> need version).
+        """
+        auth_entries = list(auth_entries)
+        # divergent suffix: anything we have beyond the auth head
+        divergent = [e for e in self.entries if e.version > auth_head]
+        if divergent:
+            self.entries = [e for e in self.entries
+                            if e.version <= auth_head]
+            self.head = self.entries[-1].version if self.entries \
+                else self.tail
+        for e in divergent:
+            # latest authoritative version of that object, if any
+            auth_v = ZERO
+            for a in reversed(auth_entries):
+                if a.oid == e.oid:
+                    auth_v = a.version
+                    break
+            if auth_v == ZERO:
+                for mine in reversed(self.entries):
+                    if mine.oid == e.oid:
+                        auth_v = mine.version
+                        break
+            self.missing[e.oid] = auth_v    # ZERO = delete/rewind to none
+        for e in auth_entries:
+            if e.version <= self.head:
+                continue
+            self.append(e)
+            if e.op == "delete":
+                self.missing.pop(e.oid, None)
+                self.missing[e.oid] = ZERO
+            else:
+                self.missing[e.oid] = e.version
+        return dict(self.missing)
+
+    def mark_recovered(self, oid: str) -> None:
+        self.missing.pop(oid, None)
+
+    def clear_missing(self) -> None:
+        self.missing.clear()
+
+    # -- persistence ---------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {"entries": [e.to_dict() for e in self.entries],
+                "tail": list(self.tail), "head": list(self.head),
+                "missing": {o: list(v) for o, v in self.missing.items()}}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PGLog":
+        log = cls()
+        log.entries = [LogEntry.from_dict(e) for e in d.get("entries", [])]
+        log.tail = tuple(d.get("tail", [0, 0]))
+        log.head = tuple(d.get("head", [0, 0]))
+        log.missing = {o: tuple(v)
+                       for o, v in d.get("missing", {}).items()}
+        return log
