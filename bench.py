@@ -10,16 +10,18 @@ CPU numbers, and a per-stage status record explains exactly what ran:
                       (PALLAS_AXON_POOL_IPS unset, JAX_PLATFORMS=cpu) —
                       cannot touch the TPU tunnel, always yields the
                       vs_baseline denominator.
-  2. `--stage probe`  `import jax; jax.devices()` only, short timeout,
-                      retried: detects a wedged axon backend cheaply.
-  3. `--stage device` the TPU benches — only launched if the probe saw a
-                      live backend. If the probe failed, the same stage is
-                      re-run hermetically on the CPU jax backend instead,
-                      so the metric still carries measured data (clearly
-                      marked platform=cpu + error).
+  2. `--stage device` ONE long-warm child: backend init (`jax.devices()`
+                      has been observed to need minutes through the axon
+                      tunnel — r1-r3 gave it only 150 s and got zero TPU
+                      data) and the benches run in the SAME process, so
+                      the warm is never thrown away. Budget ≥600 s per
+                      VERDICT r3 #1. Only if that child times out or dies
+                      is the stage re-run hermetically on the CPU jax
+                      backend (clearly marked platform=cpu + error), so
+                      the metric still carries measured data.
 
 Environment knobs:
-  CEPH_TPU_BENCH_TIMEOUT  total budget in seconds (default 1800)
+  CEPH_TPU_BENCH_TIMEOUT  total budget in seconds (default 2400)
 """
 from __future__ import annotations
 
@@ -30,10 +32,9 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
-TOTAL_BUDGET = int(os.environ.get("CEPH_TPU_BENCH_TIMEOUT", "1800"))
+TOTAL_BUDGET = int(os.environ.get("CEPH_TPU_BENCH_TIMEOUT", "2400"))
 CPU_TIMEOUT = 420
-PROBE_TIMEOUT = 150
-PROBE_ATTEMPTS = 3
+DEVICE_TIMEOUT = 900  # single long warm: backend init + benches, one child
 METRIC = "ec_encode_k8m3_1MiB_chunk"
 
 _deadline = time.monotonic() + TOTAL_BUDGET
@@ -99,25 +100,19 @@ def main() -> int:
     cpu = run_stage("cpu", _hermetic_env(), _budget(CPU_TIMEOUT))
     stages["cpu"] = cpu
 
-    # Stage 2: backend probe, retried — a wedged tunnel costs at most
-    # PROBE_ATTEMPTS * PROBE_TIMEOUT seconds, not the whole budget.
-    probe: dict = {"status": "not run"}
-    attempts = []
-    for i in range(PROBE_ATTEMPTS):
-        if time.monotonic() + PROBE_TIMEOUT > _deadline:
-            attempts.append({"status": "skipped: budget exhausted"})
-            break
-        probe = run_stage("probe", _tpu_env(), PROBE_TIMEOUT)
-        attempts.append(probe)
-        if probe["status"] == "ok":
-            break
-    stages["probe"] = {"attempts": attempts, "final": probe["status"]}
-
-    # Stage 3: device benches on the probed backend, else CPU-jax fallback.
-    tpu_live = probe.get("status") == "ok"
-    env = _tpu_env() if tpu_live else _hermetic_env()
-    device = run_stage("device", env, _budget(_deadline - time.monotonic()))
+    # Stage 2: ONE long-warm device child — backend init and benches in
+    # the same process so the (potentially minutes-long) axon warm is
+    # never discarded. Falls back to hermetic cpu-jax only if the warmed
+    # child itself dies or times out.
+    device = run_stage("device", _tpu_env(), _budget(DEVICE_TIMEOUT))
     stages["device"] = device
+    tpu_live = device.get("status") == "ok" and device.get("platform") == "tpu"
+    if device.get("status") != "ok":
+        fallback = run_stage("device", _hermetic_env(),
+                             _budget(_deadline - time.monotonic()))
+        stages["device_fallback"] = fallback
+        if fallback.get("status") == "ok":
+            device = fallback
 
     detail = {k: v for k, v in cpu.items()
               if k not in ("status", "elapsed_s", "stderr_tail")}
@@ -140,16 +135,16 @@ def main() -> int:
         "baseline": baseline_name,
         "platform": device.get("platform", "none"),
         "detail": detail,
-        "stages": {name: (s if name == "probe"
-                          else {k: s.get(k) for k in
-                                ("status", "elapsed_s", "stderr_tail")
-                                if k in s})
+        "stages": {name: {k: s.get(k) for k in
+                          ("status", "elapsed_s", "platform", "backend_init_s",
+                           "stderr_tail")
+                          if k in s}
                    for name, s in stages.items()},
     }
     if not tpu_live:
-        out["error"] = ("tpu backend unreachable after "
-                        f"{len(attempts)} probe attempts; device numbers "
-                        "are the hermetic cpu-jax fallback")
+        out["error"] = ("tpu backend did not come up inside the "
+                        f"{DEVICE_TIMEOUT}s long-warm device child; device "
+                        "numbers are the hermetic cpu-jax fallback")
     print(json.dumps(out), flush=True)
     return 0
 

@@ -105,13 +105,14 @@ def stage_device() -> dict:
     t0 = time.perf_counter()
     import jax
     platform = jax.devices()[0].platform
-    log(f"jax backend up: {platform} x{len(jax.devices())} "
-        f"({time.perf_counter() - t0:.1f}s)")
+    init_s = round(time.perf_counter() - t0, 1)
+    log(f"jax backend up: {platform} x{len(jax.devices())} ({init_s}s)")
     on_tpu = platform == "tpu"
     batch = 16 if on_tpu else 4
     iters = 40 if on_tpu else 2
 
-    results: dict[str, float] = {"platform": platform}
+    results: dict[str, float] = {"platform": platform,
+                                 "backend_init_s": init_s}
     _bench_into(results, "tpu_encode", plugin="tpu", mode="batched",
                 workload="encode", batch=batch, iterations=iters, warmup=2)
     _bench_into(results, "tpu_decode", plugin="tpu", mode="batched",
