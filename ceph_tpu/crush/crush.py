@@ -239,6 +239,8 @@ class CrushMap:
             cm._buckets[b.id] = b
             cm._next_bucket_id = min(cm._next_bucket_id, b.id - 1)
         for rd in d["rules"]:
+            rd = dict(rd)   # never mutate the caller's dict: it may be a
+            # stored incremental that other appliers will replay
             steps = [Step(**s) for s in rd.pop("steps")]
             cm._rules[rd["id"]] = Rule(steps=steps, **rd)
         cm._names = dict(d["names"])

@@ -142,6 +142,11 @@ class MonClient(Dispatcher):
         if what in self._sub_want:
             self._sub_want[what] = max(self._sub_want[what], epoch + 1)
 
+    async def request_osdmap(self, have: int = 0) -> None:
+        """Ask for the current osdmap (reply lands on on_osdmap)."""
+        conn = await self._ensure_conn()
+        conn.send_message(MMonGetMap({"what": "osdmap", "have": have}))
+
     async def send_boot(self, osd: int, addr: tuple[str, int],
                         crush_location: dict | None = None,
                         weight: float = 1.0) -> None:

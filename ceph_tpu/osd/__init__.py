@@ -1,1 +1,3 @@
-"""OSD data-plane components. Currently: EC stripe driver (ec_util)."""
+"""OSD data plane: daemon (daemon.py), PG + peering (pg.py), backends
+(backend.py replicated, ec_backend.py erasure), PGLog (pglog.py), EC
+stripe driver (ec_util.py)."""

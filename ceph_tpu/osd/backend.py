@@ -31,6 +31,12 @@ if TYPE_CHECKING:
 SUBOP_TIMEOUT = 10.0
 
 
+class IntervalChange(Exception):
+    """The peering interval changed under an in-flight op; the op is not
+    failed to the client — the primary (possibly a new one) re-runs it
+    (the reference re-queues ops across intervals instead of erroring)."""
+
+
 class PGBackend:
     """Common plumbing; subclasses implement the write/read fan-out."""
 
@@ -81,7 +87,7 @@ class PGBackend:
     def fail_inflight(self, why: str) -> None:
         for pending, fut in self._inflight.values():
             if not fut.done():
-                fut.set_exception(RuntimeError(why))
+                fut.set_exception(IntervalChange(why))
         self._inflight.clear()
 
     # -- local store helpers -------------------------------------------------

@@ -1,0 +1,402 @@
+"""PGInstance: one placement group living on one OSD.
+
+Re-creation of the reference's PG/PrimaryLogPG/PeeringState essentials
+(src/osd/PG.cc, src/osd/PrimaryLogPG.cc:1816,1982 do_request/do_op,
+src/osd/PeeringState.h:452 GetInfo->GetLog->GetMissing->Activate):
+
+  * the primary serializes client ops, stamps each with an eversion,
+    appends to the PGLog and fans the write out through its PGBackend;
+  * on every map change the PG re-peers: the primary collects peer
+    infos+logs, elects the authoritative log (max last_update, the
+    reference's find_best_info), merges it (PGLog::merge_log), pulls
+    what it is missing, pushes what the replicas are missing, and only
+    then goes active;
+  * ops arriving while peering are queued (waiting_for_active), not
+    failed — clients never see transient peering (src/osd/PG.cc
+    waiting_for_active semantics).
+
+Idiomatic divergences: peering is one coroutine instead of a
+boost::statechart; a replica whose log is unmergeable (behind the tail)
+is backfilled by full-collection push; object data rides the message
+data segment one object at a time.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import TYPE_CHECKING
+
+from ceph_tpu.crush.crush import CRUSH_NONE
+from ceph_tpu.crush.osdmap import PG
+from ceph_tpu.msg.messages import (Message, MOSDPGInfo, MOSDPGLog,
+                                   MOSDPGPush, MOSDPGPushReply, MOSDPGQuery)
+from ceph_tpu.objectstore.store import StoreError, Transaction
+from ceph_tpu.objectstore.types import CollectionId, Ghobject
+from ceph_tpu.osd.pglog import ZERO, Eversion, LogEntry, PGLog
+from ceph_tpu.utils.dout import dout
+
+if TYPE_CHECKING:
+    from ceph_tpu.osd.daemon import OSD
+
+PEER_TIMEOUT = 5.0
+PGMETA_OID = "_pgmeta_"
+
+
+class PeerSilent(Exception):
+    """An up acting peer did not answer a peering round."""
+
+
+class PGInstance:
+    """One PG on one OSD: log + backend + peering driver."""
+
+    def __init__(self, host: "OSD", pgid: PG, pool):
+        self.host = host
+        self.pgid = pgid
+        self.pool = pool
+        self.log = PGLog()
+        self.acting: list[int] = []
+        self.up: list[int] = []
+        self.state = "initial"          # initial|peering|active|replica|stray
+        self.last_epoch_started = 0
+        self.seq = 0                    # per-PG op sequence (eversion minor)
+        self._active_event = asyncio.Event()
+        self._peer_task: asyncio.Task | None = None
+        # peering scratch: peer osd -> {"info":..., "entries":...}
+        self._peer_logs: dict[int, dict] = {}
+        self._peer_waiters: dict[int, asyncio.Future] = {}
+        self._push_waiters: dict[str, asyncio.Future] = {}
+        if pool.type == "erasure":
+            from ceph_tpu.osd.ec_backend import ECBackend
+            self.backend = ECBackend(self)
+        else:
+            from ceph_tpu.osd.backend import ReplicatedBackend
+            self.backend = ReplicatedBackend(self)
+        self.backend.ensure_collections()
+        self._load_meta()
+
+    # -- identity ------------------------------------------------------------
+
+    @property
+    def primary(self) -> int:
+        for o in self.acting:
+            if o != CRUSH_NONE:
+                return o
+        return CRUSH_NONE
+
+    def is_primary(self) -> bool:
+        return self.primary == self.host.whoami
+
+    def acting_peers(self) -> set[int]:
+        return {o for o in self.acting
+                if o not in (CRUSH_NONE, self.host.whoami)}
+
+    def info(self) -> dict:
+        return {"last_update": list(self.log.head),
+                "log_tail": list(self.log.tail),
+                "last_epoch_started": self.last_epoch_started}
+
+    def next_version(self) -> Eversion:
+        self.seq += 1
+        return (self.host.osdmap.epoch, self.seq)
+
+    # -- persistence (superblock-style pg meta in the pg collection) ---------
+
+    def _meta_gh(self) -> Ghobject:
+        return Ghobject(pool=self.pgid.pool, name=PGMETA_OID)
+
+    def persist_meta(self) -> None:
+        blob = json.dumps({"log": self.log.to_dict(), "seq": self.seq,
+                           "les": self.last_epoch_started}).encode()
+        cid = self.backend.coll()
+        gh = self._meta_gh()
+        txn = Transaction()
+        if not self.host.store.exists(cid, gh):
+            txn.touch(cid, gh)
+        txn.setattr(cid, gh, "pgmeta", blob)
+        self.host.store.queue_transaction(txn)
+
+    def _load_meta(self) -> None:
+        cid = self.backend.coll()
+        try:
+            blob = self.host.store.getattr(cid, self._meta_gh(), "pgmeta")
+        except StoreError:
+            return
+        meta = json.loads(blob)
+        self.log = PGLog.from_dict(meta["log"])
+        self.seq = meta.get("seq", self.log.head[1])
+        self.last_epoch_started = meta.get("les", 0)
+
+    def list_objects(self) -> list[str]:
+        cid = self.backend.coll()
+        return sorted(gh.name for gh in self.host.store.collection_list(cid)
+                      if gh.name != PGMETA_OID)
+
+    # -- map advance ---------------------------------------------------------
+
+    def advance_map(self, up: list[int], acting: list[int]) -> None:
+        """New osdmap epoch: if the acting set changed, re-peer
+        (the reference starts a new peering interval, PeeringState
+        advance_map/start_peering_interval)."""
+        if acting == self.acting and self.state in ("active", "replica"):
+            return
+        interval_changed = acting != self.acting
+        self.up, self.acting = list(up), list(acting)
+        if interval_changed:
+            self.backend.fail_inflight("peering interval change")
+            self._cancel_peering()
+        if self.host.whoami not in self.acting:
+            self.state = "stray"
+            self._active_event.clear()
+            return
+        if self.is_primary():
+            self.state = "peering"
+            self._active_event.clear()
+            self._peer_task = asyncio.get_running_loop().create_task(
+                self._peer())
+        else:
+            # replica: wait for the primary's activation
+            self.state = "replica"
+            self._active_event.clear()
+
+    def _cancel_peering(self) -> None:
+        if self._peer_task is not None and not self._peer_task.done():
+            self._peer_task.cancel()
+        self._peer_task = None
+        for fut in self._peer_waiters.values():
+            if not fut.done():
+                fut.cancel()
+        self._peer_waiters.clear()
+
+    async def wait_active(self, timeout: float = 30.0) -> None:
+        await asyncio.wait_for(self._active_event.wait(), timeout)
+
+    # -- peering (primary coroutine) -----------------------------------------
+
+    async def _peer(self) -> None:
+        """Retry until every acting peer answers: going active without a
+        live acting peer's log would leave it permanently stale (the
+        reference blocks in Peering until the interval changes)."""
+        backoff = 0.2
+        while True:
+            try:
+                await self._peer_inner()
+                return
+            except asyncio.CancelledError:
+                raise
+            except PeerSilent as e:
+                dout("osd", 3, f"osd.{self.host.whoami} pg {self.pgid}: "
+                               f"{e}; retrying peering")
+            except Exception as e:
+                dout("osd", 2, f"osd.{self.host.whoami} pg {self.pgid}: "
+                               f"peering failed: {type(e).__name__} {e}")
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 2.0)
+
+    async def _peer_inner(self) -> None:
+        pgid_key = [self.pgid.pool, self.pgid.ps]
+        epoch = self.host.osdmap.epoch
+        # GetInfo+GetLog: ask every acting peer for info + log in one round
+        replies: dict[int, dict] = {}
+        waits = []
+        for peer in self.acting_peers():
+            fut = asyncio.get_running_loop().create_future()
+            self._peer_waiters[peer] = fut
+            await self.host.send_osd(peer, MOSDPGQuery(
+                {"pgid": pgid_key, "from": self.host.whoami,
+                 "epoch": epoch}))
+            waits.append((peer, fut))
+        silent: list[int] = []
+        for peer, fut in waits:
+            try:
+                replies[peer] = await asyncio.wait_for(fut, PEER_TIMEOUT)
+            except asyncio.TimeoutError:
+                if self.host.osdmap.is_up(peer):
+                    silent.append(peer)
+            finally:
+                self._peer_waiters.pop(peer, None)
+        if silent:
+            raise PeerSilent(f"acting peers {silent} silent during peering")
+
+        # find_best_info: max last_update wins (self is a candidate)
+        auth_osd, auth_head = self.host.whoami, self.log.head
+        for peer, rep in replies.items():
+            head = tuple(rep["info"]["last_update"])
+            if head > auth_head:
+                auth_osd, auth_head = peer, head
+
+        if auth_osd != self.host.whoami:
+            # GetMissing: merge the authoritative log, pull what we lack
+            auth = replies[auth_osd]
+            auth_entries = [LogEntry.from_dict(e) for e in auth["entries"]]
+            missing = self.log.merge_log(auth_entries, auth_head)
+            self.seq = max(self.seq, self.log.head[1])
+            for oid, need in missing.items():
+                await self._pull(auth_osd, oid, need)
+            self.log.clear_missing()
+
+        # Activate: bring every replica to the authoritative state
+        log_dict = self.log.to_dict()
+        my_objects = None
+        for peer, rep in replies.items():
+            peer_head = tuple(rep["info"]["last_update"])
+            entries = self.log.entries_since(peer_head)
+            if entries is None:
+                # peer is behind the log tail: backfill everything
+                if my_objects is None:
+                    my_objects = self.list_objects()
+                for oid in my_objects:
+                    await self._push(peer, oid)
+            else:
+                for oid in {e.oid for e in entries}:
+                    await self._push(peer, oid)
+            await self.host.send_osd(peer, MOSDPGInfo(
+                {"pgid": pgid_key, "op": "activate", "epoch": epoch,
+                 "from": self.host.whoami, "log": log_dict}))
+        self.last_epoch_started = epoch
+        self.persist_meta()
+        self.state = "active"
+        self._active_event.set()
+        dout("osd", 3, f"osd.{self.host.whoami} pg {self.pgid} active "
+                       f"(acting {self.acting}, head {self.log.head})")
+
+    async def _pull(self, peer: int, oid: str, need: Eversion) -> None:
+        """Fetch one object's authoritative state from `peer`."""
+        key = f"pull:{oid}"
+        fut = asyncio.get_running_loop().create_future()
+        self._push_waiters[key] = fut
+        try:
+            await self.host.send_osd(peer, MOSDPGPush(
+                {"pgid": [self.pgid.pool, self.pgid.ps], "op": "pull",
+                 "from": self.host.whoami, "oid": oid}))
+            await asyncio.wait_for(fut, PEER_TIMEOUT)
+        finally:
+            self._push_waiters.pop(key, None)
+
+    async def _push(self, peer: int, oid: str) -> None:
+        """Push one object's local state (or its absence) to `peer`."""
+        shard = self.backend.shard_of(peer) \
+            if hasattr(self.backend, "shard_of") else -1
+        if self.backend.local_exists(oid, shard=shard):
+            data, attrs = self.backend.read_for_push(oid, shard=shard)
+            payload = {"pgid": [self.pgid.pool, self.pgid.ps], "op": "push",
+                       "from": self.host.whoami, "oid": oid, "delete": False,
+                       "attrs": {k: v.decode("latin1")
+                                 for k, v in attrs.items()}}
+            await self.host.send_osd(peer, MOSDPGPush(payload, data))
+        else:
+            await self.host.send_osd(peer, MOSDPGPush(
+                {"pgid": [self.pgid.pool, self.pgid.ps], "op": "push",
+                 "from": self.host.whoami, "oid": oid, "delete": True}))
+
+    # -- peering message handlers (both roles) -------------------------------
+
+    async def handle_query(self, conn, msg: MOSDPGQuery) -> None:
+        """A primary wants our info + log (GetInfo+GetLog combined)."""
+        conn.send_message(MOSDPGLog(
+            {"pgid": [self.pgid.pool, self.pgid.ps],
+             "from": self.host.whoami, "info": self.info(),
+             "entries": [e.to_dict() for e in self.log.entries]}))
+
+    def handle_log(self, msg: MOSDPGLog) -> None:
+        peer = msg.payload["from"]
+        fut = self._peer_waiters.get(peer)
+        if fut is not None and not fut.done():
+            fut.set_result(msg.payload)
+
+    async def handle_push(self, conn, msg: MOSDPGPush) -> None:
+        p = msg.payload
+        shard = self.backend.my_shard() \
+            if hasattr(self.backend, "my_shard") else -1
+        if p["op"] == "pull":
+            # serve the object back to the puller
+            oid = p["oid"]
+            if self.backend.local_exists(oid, shard=shard):
+                data, attrs = self.backend.read_for_push(oid, shard=shard)
+                conn.send_message(MOSDPGPush(
+                    {"pgid": p["pgid"], "op": "push",
+                     "from": self.host.whoami, "oid": oid, "delete": False,
+                     "attrs": {k: v.decode("latin1")
+                               for k, v in attrs.items()},
+                     "reply_to": "pull"}, data))
+            else:
+                conn.send_message(MOSDPGPush(
+                    {"pgid": p["pgid"], "op": "push",
+                     "from": self.host.whoami, "oid": oid, "delete": True,
+                     "reply_to": "pull"}))
+            return
+        # incoming object state
+        attrs = {k: v.encode("latin1")
+                 for k, v in p.get("attrs", {}).items()}
+        self.backend.apply_push(p["oid"], msg.data, attrs, p["delete"],
+                                shard=shard)
+        self.log.mark_recovered(p["oid"])
+        if p.get("reply_to") == "pull":
+            fut = self._push_waiters.get(f"pull:{p['oid']}")
+            if fut is not None and not fut.done():
+                fut.set_result(None)
+        else:
+            conn.send_message(MOSDPGPushReply(
+                {"pgid": p["pgid"], "oid": p["oid"],
+                 "from": self.host.whoami}))
+
+    def handle_activate(self, msg: MOSDPGInfo) -> None:
+        """Primary says: adopt this log, you are consistent now."""
+        p = msg.payload
+        auth = PGLog.from_dict(p["log"])
+        self.log = auth
+        self.log.clear_missing()
+        self.seq = max(self.seq, self.log.head[1])
+        self.last_epoch_started = p["epoch"]
+        self.state = "replica"
+        self.persist_meta()
+        self._active_event.set()
+
+    # -- client op execution (primary only) ----------------------------------
+
+    async def do_op(self, op: dict, data: bytes) -> tuple[int, dict, bytes]:
+        """Execute one client op; returns (rc, out, outdata)."""
+        await self.wait_active()
+        oid = op["oid"]
+        kind = op["op"]
+        if kind == "write_full":
+            version = self.next_version()
+            entry = LogEntry(version=version, op="modify", oid=oid,
+                             prior_version=self._prior(oid))
+            await self.backend.execute_write(oid, "write_full", data, entry)
+            self.log.append(entry)
+            self.persist_meta()
+            return 0, {"version": list(version)}, b""
+        if kind == "delete":
+            if not self.backend.local_exists(
+                    oid, shard=self.backend.my_shard()
+                    if hasattr(self.backend, "my_shard") else -1):
+                return -2, {"error": "ENOENT"}, b""
+            version = self.next_version()
+            entry = LogEntry(version=version, op="delete", oid=oid,
+                             prior_version=self._prior(oid))
+            await self.backend.execute_write(oid, "delete", b"", entry)
+            self.log.append(entry)
+            self.persist_meta()
+            return 0, {"version": list(version)}, b""
+        if kind == "read":
+            try:
+                out = await self.backend.execute_read(
+                    oid, op.get("off", 0), op.get("len", 0))
+            except StoreError as e:
+                return -2, {"error": str(e)}, b""
+            return 0, {}, out
+        if kind == "stat":
+            try:
+                size = self.backend.object_size(oid)
+            except StoreError as e:
+                return -2, {"error": str(e)}, b""
+            return 0, {"size": size}, b""
+        if kind == "list":
+            return 0, {"objects": self.list_objects()}, b""
+        return -22, {"error": f"unknown op {kind!r}"}, b""
+
+    def _prior(self, oid: str) -> Eversion:
+        for e in reversed(self.log.entries):
+            if e.oid == oid:
+                return e.version
+        return ZERO

@@ -1,6 +1,9 @@
-"""Client library: Objecter-style placement + resend (under construction).
+"""Client library: librados-subset + Objecter-lite.
 
-Will hold the librados-subset client (reference src/osdc/Objecter.cc,
-src/librados/): object->PG->OSD targeting from the current OSDMap epoch
-and resend-on-map-change. Empty until that lands; nothing is re-exported.
+Reference: src/osdc/Objecter.cc (placement + resend-on-map-change),
+src/librados/librados_c.cc (public API shape).
 """
+from ceph_tpu.rados.client import (IoCtx, ObjectNotFound, RadosClient,
+                                   RadosError)
+
+__all__ = ["IoCtx", "ObjectNotFound", "RadosClient", "RadosError"]

@@ -1,0 +1,260 @@
+"""librados-subset client: RadosClient + IoCtx over an Objecter-lite.
+
+Re-creation of the reference client stack's essentials:
+  * Objecter placement + retry (src/osdc/Objecter.cc:2783 _calc_target
+    computes pg + primary from the osdmap; ops are resent on map epoch
+    change rather than failed — :2286 _op_submit);
+  * librados surface (src/librados/librados_c.cc:1308 rados_write ->
+    IoCtxImpl::write -> operate): connect, pool I/O contexts, synchronous
+    object ops, pool/profile admin via mon commands.
+
+Idiomatic divergences: JSON command plane instead of the CLI encoding;
+one lossy connection per OSD re-established on fault; a -11 reply or a
+sub-op timeout triggers a map refresh + recompute instead of the
+reference's epoch broadcast machinery.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ceph_tpu.crush.crush import CRUSH_NONE
+from ceph_tpu.crush.osdmap import Incremental, OSDMap
+from ceph_tpu.msg.messages import Message, MOSDOp, MOSDOpReply
+from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
+from ceph_tpu.mon.mon_client import MonClient
+from ceph_tpu.utils.dout import dout
+
+import json
+
+
+class RadosError(Exception):
+    def __init__(self, rc: int, message: str):
+        super().__init__(f"rc={rc}: {message}")
+        self.rc = rc
+
+
+class ObjectNotFound(RadosError):
+    pass
+
+
+class RadosClient(Dispatcher):
+    """rados_connect + Objecter-lite (placement, resend on epoch change)."""
+
+    OP_TIMEOUT = 15.0
+    ATTEMPT_TIMEOUT = 5.0
+
+    def __init__(self, mon_addrs: list[tuple[str, int]]):
+        self.messenger = Messenger("client")
+        self.messenger.add_dispatcher(self)
+        self.monc = MonClient(self.messenger, mon_addrs)
+        self.monc.on_osdmap = self._on_osdmap
+        self.osdmap = OSDMap()
+        self._map_changed = asyncio.Event()
+        self._tid = 0
+        self._waiters: dict[int, asyncio.Future] = {}
+        self._osd_conns: dict[int, Connection] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def connect(self) -> None:
+        await self.messenger.bind("127.0.0.1", 0)
+        await self.monc.start()
+        self.monc.subscribe("osdmap", 1)
+        await self.wait_for_map()
+
+    async def shutdown(self) -> None:
+        await self.monc.close()
+        await self.messenger.shutdown()
+
+    # -- map handling --------------------------------------------------------
+
+    def _on_osdmap(self, payload: dict) -> None:
+        if payload.get("full") is not None:
+            full = payload["full"]
+            if full["epoch"] > self.osdmap.epoch:
+                self.osdmap.load_dict(full)
+        for raw in payload.get("incrementals", []):
+            inc = Incremental.from_dict(
+                json.loads(raw) if isinstance(raw, str) else raw)
+            if inc.epoch == self.osdmap.epoch + 1:
+                self.osdmap.apply_incremental(inc)
+        self.monc.sub_got("osdmap", self.osdmap.epoch)
+        self._map_changed.set()
+
+    async def wait_for_map(self, min_epoch: int = 1,
+                           timeout: float = 15.0) -> None:
+        deadline = time.monotonic() + timeout
+        while self.osdmap.epoch < min_epoch:
+            self._map_changed.clear()
+            await self.monc.request_osdmap(self.osdmap.epoch)
+            try:
+                await asyncio.wait_for(
+                    self._map_changed.wait(),
+                    max(0.1, min(2.0, deadline - time.monotonic())))
+            except asyncio.TimeoutError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"no osdmap epoch >= {min_epoch}") from None
+
+    # -- admin plane ---------------------------------------------------------
+
+    async def command(self, cmd: dict, timeout: float = 30.0) -> dict:
+        return await self.monc.command(cmd, timeout=timeout)
+
+    async def pool_create(self, name: str, **kwargs) -> dict:
+        out = await self.command({"prefix": "osd pool create", "pool": name,
+                                  **{k: v for k, v in kwargs.items()}})
+        # wait until our map shows the pool so I/O can target it
+        deadline = time.monotonic() + 15.0
+        while name not in self.osdmap.pool_names:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"pool {name!r} never appeared in map")
+            await self.wait_for_map(self.osdmap.epoch + 1)
+        return out
+
+    def ioctx(self, pool_name: str) -> "IoCtx":
+        return IoCtx(self, pool_name)
+
+    # -- objecter ------------------------------------------------------------
+
+    async def _osd_conn(self, osd: int) -> Connection:
+        conn = self._osd_conns.get(osd)
+        if conn is not None and not conn._closed and conn.connected:
+            return conn
+        a = self.osdmap.get_addr(osd)
+        conn = await self.messenger.connect((a[0], int(a[1])),
+                                            Policy.lossy_client())
+        self._osd_conns[osd] = conn
+        return conn
+
+    async def submit(self, pool_name: str, oid: str, ops: list[dict],
+                     data: bytes = b"", timeout: float | None = None,
+                     pgid=None) -> tuple[dict, bytes]:
+        """Objecter::op_submit-lite: compute the target, send, resend on
+        epoch change / wrong-primary / transport fault. `pgid` pins the
+        target PG (PG-scoped ops like `list`)."""
+        deadline = time.monotonic() + (timeout or self.OP_TIMEOUT)
+        last = "no attempt"
+        while time.monotonic() < deadline:
+            if pool_name not in self.osdmap.pool_names:
+                raise RadosError(-2, f"pool {pool_name!r} does not exist")
+            pg = pgid if pgid is not None \
+                else self.osdmap.object_to_pg(pool_name, oid)
+            primary = self.osdmap.primary(pg)
+            if primary == CRUSH_NONE:
+                last = f"pg {pg} has no primary"
+                await self._refresh_map(deadline)
+                continue
+            try:
+                conn = await self._osd_conn(primary)
+            except Exception as e:
+                last = f"osd.{primary} unreachable: {e}"
+                await self._refresh_map(deadline)
+                continue
+            self._tid += 1
+            tid = self._tid
+            fut = asyncio.get_running_loop().create_future()
+            self._waiters[tid] = fut
+            conn.send_message(MOSDOp(
+                {"tid": tid, "pgid": [pg.pool, pg.ps], "oid": oid,
+                 "ops": ops, "epoch": self.osdmap.epoch}, data))
+            try:
+                reply = await asyncio.wait_for(
+                    fut, min(self.ATTEMPT_TIMEOUT,
+                             max(0.1, deadline - time.monotonic())))
+            except asyncio.TimeoutError:
+                last = f"op timeout against osd.{primary}"
+                self._osd_conns.pop(primary, None)
+                await self._refresh_map(deadline)
+                continue
+            finally:
+                self._waiters.pop(tid, None)
+            p, outdata = reply
+            rc = p.get("rc", 0)
+            if rc == -11:            # wrong primary / stale map: recompute
+                last = p.get("error", "wrong target")
+                await self._refresh_map(deadline)
+                continue
+            if rc == -110:           # primary lost a replica mid-op: the op
+                last = "sub-op timeout"   # is retried on the new interval
+                await self._refresh_map(deadline)
+                continue
+            if rc == -2:
+                raise ObjectNotFound(rc, p.get("error", oid))
+            if rc < 0:
+                raise RadosError(rc, p.get("error", "op failed"))
+            return p, outdata
+        raise TimeoutError(f"op on {oid!r} timed out ({last})")
+
+    async def _refresh_map(self, deadline: float) -> None:
+        self._map_changed.clear()
+        try:
+            await self.monc.request_osdmap(self.osdmap.epoch)
+            await asyncio.wait_for(
+                self._map_changed.wait(),
+                max(0.1, min(1.0, deadline - time.monotonic())))
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+
+    # -- dispatch ------------------------------------------------------------
+
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, MOSDOpReply):
+            fut = self._waiters.get(msg.payload.get("tid", 0))
+            if fut is not None and not fut.done():
+                fut.set_result((msg.payload, msg.data))
+            return True
+        return False
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        for osd, c in list(self._osd_conns.items()):
+            if c is conn:
+                del self._osd_conns[osd]
+
+
+class IoCtx:
+    """Synchronous-ish per-pool I/O context (librados IoCtx)."""
+
+    def __init__(self, client: RadosClient, pool_name: str):
+        self.client = client
+        self.pool_name = pool_name
+
+    async def write_full(self, oid: str, data: bytes) -> dict:
+        p, _ = await self.client.submit(
+            self.pool_name, oid, [{"op": "write_full", "oid": oid}], data)
+        return p
+
+    async def read(self, oid: str, offset: int = 0, length: int = 0) -> bytes:
+        _, data = await self.client.submit(
+            self.pool_name, oid,
+            [{"op": "read", "oid": oid, "off": offset, "len": length}])
+        return data
+
+    async def remove(self, oid: str) -> dict:
+        p, _ = await self.client.submit(
+            self.pool_name, oid, [{"op": "delete", "oid": oid}])
+        return p
+
+    async def stat(self, oid: str) -> dict:
+        p, _ = await self.client.submit(
+            self.pool_name, oid, [{"op": "stat", "oid": oid}])
+        return p["results"][0]["out"]
+
+    async def list_objects(self) -> list[str]:
+        """Union of object listings across this pool's PG primaries."""
+        from ceph_tpu.crush.osdmap import PG as PGId
+        seen: set[str] = set()
+        pool = self.client.osdmap.get_pool(self.pool_name)
+        for ps in range(pool.pg_num):
+            pg = PGId(pool.id, ps)
+            if self.client.osdmap.primary(pg) == CRUSH_NONE:
+                continue
+            try:
+                p, _ = await self.client.submit(
+                    self.pool_name, f"pg{ps}", [{"op": "list", "oid": ""}],
+                    pgid=pg)
+            except (RadosError, TimeoutError):
+                continue
+            seen.update(p["results"][0]["out"].get("objects", []))
+        return sorted(seen)

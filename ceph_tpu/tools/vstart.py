@@ -1,0 +1,166 @@
+"""vstart: boot a dev cluster (mons + osds) in one process.
+
+Re-creation of the reference's src/vstart.sh developer cluster: spin up
+a monitor quorum and a set of OSDs on localhost sockets, then hand out
+librados-subset clients. Used by tests, the verify workflow, and the
+CLI smoke mode (`python -m ceph_tpu.tools.vstart --smoke`).
+
+Idiomatic divergences: daemons are asyncio objects in one process (the
+reference forks real processes); `--smoke` runs a writeback workload
+the way qa/standalone/ceph-helpers.sh tests do, instead of leaving an
+interactive cluster behind.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import socket
+import sys
+import tempfile
+
+from ceph_tpu.mon.monitor import MonMap, Monitor
+from ceph_tpu.osd.daemon import OSD
+from ceph_tpu.rados.client import RadosClient
+
+
+def free_ports(n: int) -> list[int]:
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+class VCluster:
+    """A running dev cluster: n mons + m osds, all in-process."""
+
+    def __init__(self, base_dir: str, n_mons: int = 1, n_osds: int = 3):
+        ports = free_ports(n_mons)
+        self.monmap = MonMap({f"m{i}": ("127.0.0.1", ports[i])
+                              for i in range(n_mons)})
+        self.base_dir = base_dir
+        self.n_osds = n_osds
+        self.mons: dict[str, Monitor] = {}
+        self.osds: dict[int, OSD] = {}
+        self.clients: list[RadosClient] = []
+
+    @property
+    def mon_addrs(self) -> list[tuple[str, int]]:
+        return list(self.monmap.mons.values())
+
+    async def start(self) -> None:
+        for name in self.monmap.mons:
+            mon = Monitor(name, self.monmap,
+                          store_path=f"{self.base_dir}/mon.{name}")
+            self.mons[name] = mon
+            await mon.start()
+        deadline = asyncio.get_running_loop().time() + 30
+        while not any(m.paxos.is_leader() and m.paxos.is_active()
+                      for m in self.mons.values()):
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError("monitor quorum never formed")
+            await asyncio.sleep(0.05)
+        for i in range(self.n_osds):
+            await self.start_osd(i)
+
+    async def start_osd(self, i: int, store=None) -> OSD:
+        osd = OSD(i, self.mon_addrs, store=store)
+        self.osds[i] = osd
+        await osd.start()
+        return osd
+
+    async def kill_osd(self, i: int) -> None:
+        await self.osds.pop(i).stop()
+
+    async def client(self) -> RadosClient:
+        c = RadosClient(self.mon_addrs)
+        await c.connect()
+        self.clients.append(c)
+        return c
+
+    async def stop(self) -> None:
+        for c in self.clients:
+            try:
+                await c.shutdown()
+            except Exception:
+                pass
+        for osd in list(self.osds.values()):
+            try:
+                await osd.stop()
+            except Exception:
+                pass
+        for mon in self.mons.values():
+            try:
+                await mon.stop()
+            except Exception:
+                pass
+
+    def status(self) -> dict:
+        leader = next((m for m in self.mons.values()
+                       if m.paxos.is_leader()), None)
+        osdmap = leader.osdmon.osdmap if leader else None
+        return {
+            "mons": {name: {"rank": m.rank,
+                            "leader": m.paxos.is_leader(),
+                            "quorum": sorted(m.paxos.quorum)}
+                     for name, m in self.mons.items()},
+            "osdmap_epoch": osdmap.epoch if osdmap else 0,
+            "osds": {i: {"up": bool(osdmap and osdmap.is_up(i)),
+                         "pgs": len(o.pgs)}
+                     for i, o in self.osds.items()},
+            "pools": ({p.name: {"type": p.type, "size": p.size,
+                                "pg_num": p.pg_num}
+                       for p in osdmap.pools.values()} if osdmap else {}),
+        }
+
+
+async def smoke(n_mons: int, n_osds: int) -> dict:
+    """Boot, write/read through a replicated pool, report. Exit-code
+    contract: raises on any failure, returns the status dict on success."""
+    with tempfile.TemporaryDirectory(prefix="vstart-") as base:
+        c = VCluster(base, n_mons=n_mons, n_osds=n_osds)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("smoke", pg_num=8, size=min(3, n_osds))
+            io = cl.ioctx("smoke")
+            for i in range(10):
+                await io.write_full(f"o{i}", f"payload-{i}".encode() * 10)
+            for i in range(10):
+                got = await io.read(f"o{i}")
+                want = f"payload-{i}".encode() * 10
+                if got != want:
+                    raise AssertionError(f"o{i}: read {got[:20]!r}...")
+            listed = await io.list_objects()
+            if listed != [f"o{i}" for i in range(10)]:
+                raise AssertionError(f"bad listing: {listed}")
+            status = c.status()
+            status["smoke"] = "ok: 10 objects wrote+read+listed"
+            return status
+        finally:
+            await c.stop()
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mons", type=int, default=1)
+    p.add_argument("--osds", type=int, default=3)
+    p.add_argument("--smoke", action="store_true",
+                   help="run a write/read workload and exit")
+    args = p.parse_args()
+    if not args.smoke:
+        p.error("only --smoke mode is supported (in-process daemons "
+                "cannot outlive the interpreter)")
+    status = asyncio.run(asyncio.wait_for(smoke(args.mons, args.osds), 120))
+    print(json.dumps(status, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,231 @@
+"""Single-host cluster integration tests: real daemons, real sockets,
+one process (the reference's qa/standalone/ceph-helpers.sh tier —
+test-erasure-code.sh boots mon+osds and writes/rereads with chunks
+deleted; here the replicated path is the first slice).
+
+Scenarios from the r3 verdict item #3: boot 1 mon + 3 osds, create a
+pool, write/read 100 objects through the librados-subset client, kill
+one osd (heartbeat failure reports -> mon marks it down -> new epoch ->
+re-peering) and keep writing/reading.
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.mon import MonMap, Monitor
+from ceph_tpu.mon.paxos import Paxos
+from ceph_tpu.msg.messenger import Connection
+from ceph_tpu.osd.daemon import OSD
+from ceph_tpu.rados import RadosClient
+
+from tests.test_mon import free_ports
+
+
+def run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+@pytest.fixture(autouse=True)
+def fast_timers(monkeypatch):
+    monkeypatch.setattr(Paxos, "ELECTION_TIMEOUT", 0.15)
+    monkeypatch.setattr(Paxos, "LEASE_INTERVAL", 0.2)
+    monkeypatch.setattr(Paxos, "LEASE_TIMEOUT", 1.0)
+    monkeypatch.setattr(Paxos, "ACCEPT_TIMEOUT", 0.8)
+    monkeypatch.setattr(Connection, "KEEPALIVE_INTERVAL", 0.3)
+    monkeypatch.setattr(Connection, "KEEPALIVE_TIMEOUT", 1.5)
+    monkeypatch.setattr(Connection, "PARK_TIMEOUT", 2.0)
+    monkeypatch.setattr(OSD, "HB_INTERVAL", 0.25)
+    monkeypatch.setattr(OSD, "HB_GRACE", 1.2)
+
+
+class ClusterHarness:
+    """run_mon + run_osd equivalent (qa/standalone/ceph-helpers.sh)."""
+
+    def __init__(self, tmp_path, n_mons: int = 1, n_osds: int = 3):
+        ports = free_ports(n_mons)
+        self.monmap = MonMap({f"m{i}": ("127.0.0.1", ports[i])
+                              for i in range(n_mons)})
+        self.tmp_path = tmp_path
+        self.mons: dict[str, Monitor] = {}
+        self.osds: dict[int, OSD] = {}
+        self.n_osds = n_osds
+        self.clients: list[RadosClient] = []
+
+    @property
+    def mon_addrs(self):
+        return list(self.monmap.mons.values())
+
+    async def start(self) -> None:
+        for name in self.monmap.mons:
+            mon = Monitor(name, self.monmap,
+                          store_path=str(self.tmp_path / f"mon.{name}"))
+            self.mons[name] = mon
+            await mon.start()
+        # wait for a working quorum before booting osds
+        deadline = asyncio.get_running_loop().time() + 20
+        while not any(m.paxos.is_leader() and m.paxos.is_active()
+                      for m in self.mons.values()):
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError("no mon leader")
+            await asyncio.sleep(0.05)
+        for i in range(self.n_osds):
+            await self.start_osd(i)
+
+    async def start_osd(self, i: int, store=None) -> OSD:
+        osd = OSD(i, self.mon_addrs, store=store)
+        self.osds[i] = osd
+        await osd.start()
+        return osd
+
+    async def kill_osd(self, i: int) -> None:
+        await self.osds.pop(i).stop()
+
+    async def client(self) -> RadosClient:
+        c = RadosClient(self.mon_addrs)
+        await c.connect()
+        self.clients.append(c)
+        return c
+
+    async def wait_osd_down(self, i: int, timeout: float = 20.0) -> None:
+        """Wait until every surviving osd's map shows osd.i down."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            maps = [o.osdmap for o in self.osds.values()]
+            if maps and all(i in m.osds and not m.osds[i].up for m in maps):
+                return
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(f"osd.{i} never marked down")
+            await asyncio.sleep(0.1)
+
+    async def stop(self) -> None:
+        for c in self.clients:
+            try:
+                await c.shutdown()
+            except Exception:
+                pass
+        for osd in list(self.osds.values()):
+            try:
+                await osd.stop()
+            except Exception:
+                pass
+        for mon in self.mons.values():
+            try:
+                await mon.stop()
+            except Exception:
+                pass
+
+
+def test_replicated_pool_end_to_end(tmp_path):
+    """1 mon + 3 osds; write/read/list/stat/delete 100 objects."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=8, size=3)
+            io = cl.ioctx("rbd")
+            payloads = {f"obj{i:03d}": (f"payload-{i:03d}-".encode() * 17)
+                        for i in range(100)}
+            for oid, data in payloads.items():
+                await io.write_full(oid, data)
+            for oid, data in payloads.items():
+                assert await io.read(oid) == data
+            st = await io.stat("obj007")
+            assert st["size"] == len(payloads["obj007"])
+            listed = await io.list_objects()
+            assert listed == sorted(payloads)
+            await io.remove("obj000")
+            with pytest.raises(Exception):
+                await io.read("obj000")
+            # the write actually replicated: every osd holds every object
+            counts = []
+            for osd in c.osds.values():
+                n = sum(len(pg.list_objects()) for pg in osd.pgs.values()
+                        if pg.state in ("active", "replica"))
+                counts.append(n)
+            assert sum(counts) == 3 * 99, counts
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_osd_death_cluster_survives(tmp_path):
+    """Kill one osd: failure reports mark it down, writes/reads continue
+    on the surviving acting sets."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=8, size=3)
+            io = cl.ioctx("rbd")
+            for i in range(30):
+                await io.write_full(f"pre{i:02d}", b"x" * 500 + bytes([i]))
+            await c.kill_osd(2)
+            await c.wait_osd_down(2)
+            # old data still readable, new writes land on survivors
+            for i in range(30):
+                assert await io.read(f"pre{i:02d}") == b"x" * 500 + bytes([i])
+            for i in range(30):
+                await io.write_full(f"post{i:02d}", b"y" * 300 + bytes([i]))
+            for i in range(30):
+                assert (await io.read(f"post{i:02d}")
+                        == b"y" * 300 + bytes([i]))
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_osd_restart_recovers_by_log(tmp_path):
+    """Kill an osd, write while it is down, restart it with the same
+    store: peering pushes it the writes it missed (log-driven recovery,
+    PGLog::merge_log semantics) and it serves reads again."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=8, size=3)
+            io = cl.ioctx("rbd")
+            for i in range(20):
+                await io.write_full(f"a{i:02d}", b"first" + bytes([i]))
+            victim = c.osds[1]
+            store = victim.store
+            await c.kill_osd(1)
+            await c.wait_osd_down(1)
+            # writes the dead osd misses (overwrites + fresh objects)
+            for i in range(20):
+                await io.write_full(f"a{i:02d}", b"second" + bytes([i]))
+            for i in range(10):
+                await io.write_full(f"b{i:02d}", b"new" + bytes([i]))
+            # restart from the surviving store: boots, re-peers, recovers
+            await c.start_osd(1, store=store)
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                osd = c.osds[1]
+                stale = []
+                for pg in osd.pgs.values():
+                    if pg.state not in ("active", "replica"):
+                        continue
+                    for oid in pg.list_objects():
+                        data = osd.store.read(pg.backend.coll(),
+                                              pg.backend.ghobject(oid))
+                        if oid.startswith("a") and not \
+                                data.startswith(b"second"):
+                            stale.append(oid)
+                have = {oid for pg in osd.pgs.values()
+                        for oid in pg.list_objects()}
+                want = {f"a{i:02d}" for i in range(20)} \
+                    | {f"b{i:02d}" for i in range(10)}
+                if not stale and want <= have:
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError(
+                        f"recovery incomplete: stale={stale[:5]} "
+                        f"missing={sorted(want - have)[:5]}")
+                await asyncio.sleep(0.2)
+        finally:
+            await c.stop()
+    run(body())
