@@ -138,7 +138,11 @@ class PGBackend:
     def object_size(self, oid: str) -> int:
         raise NotImplementedError
 
-    # recovery hooks (PG peering calls these)
+    async def execute_stat(self, oid: str) -> int:
+        return self.object_size(oid)
+
+    # -- recovery hooks (PG peering calls these) -----------------------------
+
     def read_for_push(self, oid: str, shard: int = -1) -> tuple[bytes, dict]:
         """Object payload + attrs for a recovery push."""
         cid, gh = self.coll(shard), self.ghobject(oid, shard)
@@ -151,6 +155,20 @@ class PGBackend:
             self.local_apply(oid, "delete", b"", shard=shard)
         else:
             self.local_apply(oid, "push", data, attrs=attrs, shard=shard)
+
+    async def push_object(self, peer: int, oid: str) -> None:
+        """Push this object's local state (or its absence) to `peer`.
+        The EC backend overrides this to reconstruct the peer's
+        positional chunk instead."""
+        if self.local_exists(oid):
+            data, attrs = self.read_for_push(oid)
+            await self.pg.send_push(peer, oid, data, attrs, delete=False)
+        else:
+            await self.pg.send_push(peer, oid, b"", None, delete=True)
+
+    async def pull_object(self, auth_peer: int, oid: str, need) -> None:
+        """Fetch this object's authoritative state from `auth_peer`."""
+        await self.pg.pull_transport(auth_peer, oid)
 
 
 class ReplicatedBackend(PGBackend):

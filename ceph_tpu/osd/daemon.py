@@ -54,6 +54,7 @@ class OSD(Dispatcher):
         self._conns: dict[int, Connection] = {}
         self._booted = asyncio.Event()
         self._hb_task: asyncio.Task | None = None
+        self._reboot_task: asyncio.Task | None = None
         self._hb_last: dict[int, float] = {}      # peer -> last reply stamp
         self._hb_reported: set[int] = set()
         self._stopping = False
@@ -86,14 +87,30 @@ class OSD(Dispatcher):
         dout("osd", 1, f"osd.{self.whoami} up at {self.addr}")
         return self.addr
 
+    async def _reboot_until_up(self) -> None:
+        """Resend MOSDBoot until the map shows us up again (mirrors the
+        resend loop in start(); survives mon churn mid-send)."""
+        while not self._stopping:
+            me = self.osdmap.osds.get(self.whoami)
+            if me is not None and me.up and self._same_addr(me.addr):
+                return
+            try:
+                await self.monc.send_boot(self.whoami, self.addr,
+                                          crush_location=self.crush_location)
+            except Exception as e:
+                dout("osd", 5, f"osd.{self.whoami} re-boot send failed: "
+                               f"{type(e).__name__} {e}")
+            await asyncio.sleep(2.0)
+
     async def stop(self) -> None:
         self._stopping = True
-        if self._hb_task is not None:
-            self._hb_task.cancel()
-            try:
-                await self._hb_task
-            except (asyncio.CancelledError, Exception):
-                pass
+        for task in (self._hb_task, self._reboot_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
         for pg in self.pgs.values():
             pg._cancel_peering()
             pg.backend.fail_inflight("osd stopping")
@@ -127,6 +144,15 @@ class OSD(Dispatcher):
         me = self.osdmap.osds.get(self.whoami)
         if me is not None and me.up and self._same_addr(me.addr):
             self._booted.set()
+        elif self._booted.is_set() and me is not None and not me.up \
+                and not self._stopping:
+            # we are alive but the map says down (wrongly marked):
+            # re-boot, as the reference OSD does on a spurious mark-down
+            if self._reboot_task is None or self._reboot_task.done():
+                dout("osd", 1, f"osd.{self.whoami} wrongly marked down; "
+                               f"re-booting")
+                self._reboot_task = asyncio.get_running_loop().create_task(
+                    self._reboot_until_up())
         for peer in list(self._conns):
             if not self.osdmap.is_up(peer):
                 self._drop_conn(peer)
@@ -217,7 +243,10 @@ class OSD(Dispatcher):
 
     async def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
         if isinstance(msg, MPing):
-            conn.send_message(MPingReply(dict(msg.payload)))
+            # the reply must name the RESPONDER: the pinger keys its
+            # liveness table by who answered, not by who asked
+            conn.send_message(MPingReply(
+                {"stamp": msg.payload.get("stamp"), "from": self.whoami}))
             return True
         if isinstance(msg, MPingReply):
             peer = msg.payload.get("from")

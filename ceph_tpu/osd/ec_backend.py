@@ -1,0 +1,331 @@
+"""ECBackend: the erasure-coded PGBackend — the TPU codec's production
+caller.
+
+Re-creation of the reference EC write/read pipeline
+(src/osd/ECBackend.cc, src/osd/ECCommon.cc):
+  * writes stripe-encode the object through the pool's EC plugin and fan
+    per-shard sub-writes to the acting set's positions, acking the
+    client only when ALL live shards commit (ECCommon.cc:704 start_rmw,
+    :789 try_reads_to_commit; sub-write apply ECBackend.cc:936);
+  * reads gather any k shards — degraded reads reconstruct missing
+    chunks via the plugin decode (ReadPipeline, ECCommon.cc:597
+    objects_read_and_reconstruct, minimum_to_decode :281);
+  * per-shard chunk crc32c rides an object attr and is verified when a
+    shard is served (HashInfo, src/osd/ECUtil.h:141; verify at read
+    ECBackend.cc:1092-1120);
+  * recovery reconstructs a lost position's chunk from k survivors and
+    pushes it (RecoveryOp, ECBackend.h:191).
+
+Idiomatic divergences: whole-object writes (write_full) instead of the
+RMW partial-overwrite pipeline, so no ExtentCache; chunks live in the
+PG's collection with their shard index as an attr instead of
+shard-suffixed collections (one OSD holds at most one shard of a PG);
+encode/decode go through the batched ec_util driver — on a TPU backend
+one device dispatch per stripe batch.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+
+from ceph_tpu.crush.crush import CRUSH_NONE
+from ceph_tpu.ec import registry
+from ceph_tpu.msg.messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
+                                   MOSDECSubOpWrite, MOSDECSubOpWriteReply)
+from ceph_tpu.objectstore.store import StoreError
+from ceph_tpu.osd import ec_util
+from ceph_tpu.osd.backend import (SUBOP_TIMEOUT, IntervalChange, PGBackend)
+from ceph_tpu.osd.pglog import LogEntry
+from ceph_tpu.utils.dout import dout
+
+READ_TIMEOUT = 5.0
+
+
+class ECBackend(PGBackend):
+    """Erasure-coded writes/reads over the acting set's shard positions."""
+
+    def __init__(self, pg):
+        super().__init__(pg)
+        profile = dict(pg.host.osdmap.ec_profiles[pg.pool.ec_profile])
+        self.ec_impl = registry.factory(profile.get("plugin", "jerasure"),
+                                        profile)
+        self.k = self.ec_impl.get_data_chunk_count()
+        self.n = self.ec_impl.get_chunk_count()
+        width = pg.pool.stripe_width or self.k * 4096
+        self.sinfo = ec_util.StripeInfo(self.k, width)
+        # read gather plumbing: tid -> future resolving to (payload, data)
+        self._read_waiters: dict[int, asyncio.Future] = {}
+
+    # -- helpers -------------------------------------------------------------
+
+    def _live_positions(self) -> dict[int, int]:
+        """shard index -> osd id for every non-hole acting position."""
+        return {i: o for i, o in enumerate(self.pg.acting)
+                if o != CRUSH_NONE and self.host.osdmap.is_up(o)}
+
+    def _pad(self, data: bytes) -> bytes:
+        w = self.sinfo.stripe_width
+        pad = (-len(data)) % w
+        return data + b"\x00" * pad if pad or data else b"\x00" * w
+
+    def _chunk_attrs(self, shard: int, size: int, hinfo: dict,
+                     version) -> dict:
+        return {"shard": str(shard).encode(),
+                "ec_size": str(size).encode(),
+                "hinfo": json.dumps(hinfo).encode(),
+                "version": json.dumps(list(version)).encode()}
+
+    # -- write path (RMWPipeline-lite) ---------------------------------------
+
+    async def execute_write(self, oid: str, op: str, data: bytes,
+                            entry: LogEntry) -> None:
+        live = self._live_positions()
+        if len(live) < self.pg.pool.min_size:
+            # the reference blocks the op until min_size is met; our
+            # client resends until the interval heals
+            raise IntervalChange(
+                f"ec pg {self.pg.pgid}: {len(live)} live shards < "
+                f"min_size {self.pg.pool.min_size}")
+        tid = self.new_tid()
+        peers = {o for o in live.values() if o != self.host.whoami}
+        fut = self._start_waiting(tid, peers)
+
+        if op in ("write_full", "push"):
+            padded = self._pad(data)
+            shards = ec_util.encode(self.sinfo, self.ec_impl, padded)
+            hinfo = ec_util.HashInfo(self.n)
+            hinfo.append(0, shards)
+            hd = hinfo.to_dict()
+            payloads = {i: (self._chunk_attrs(i, len(data), hd,
+                                              entry.version), shards[i])
+                        for i in live}
+        elif op in ("delete", "remove"):
+            payloads = {i: (None, b"") for i in live}
+        else:
+            raise StoreError("EINVAL", f"unknown ec op {op!r}")
+
+        for idx, osd in live.items():
+            attrs, chunk = payloads[idx]
+            if osd == self.host.whoami:
+                self._apply_chunk(oid, op, chunk, attrs)
+            else:
+                await self.host.send_osd(osd, MOSDECSubOpWrite(
+                    {"pgid": [self.pg.pgid.pool, self.pg.pgid.ps],
+                     "tid": tid, "from": self.host.whoami, "oid": oid,
+                     "op": op, "shard": idx,
+                     "attrs": ({k: v.decode("latin1")
+                                for k, v in attrs.items()}
+                               if attrs else None),
+                     "entry": entry.to_dict()}, chunk))
+        await asyncio.wait_for(fut, SUBOP_TIMEOUT)
+
+    def _apply_chunk(self, oid: str, op: str, chunk: bytes,
+                     attrs: dict | None) -> None:
+        if op in ("write_full", "push"):
+            self.local_apply(oid, "push", chunk, attrs=attrs)
+        else:
+            self.local_apply(oid, "delete", b"")
+
+    # -- read path (ReadPipeline-lite) ---------------------------------------
+
+    async def _gather_chunks(
+            self, oid: str,
+            exclude_osds: frozenset = frozenset(),
+    ) -> tuple[dict[int, bytes], int, dict]:
+        """Collect shard chunks until a version-consistent decodable set
+        exists; returns ({shard: chunk}, logical size, hinfo dict).
+
+        Shards carry the eversion of the write that produced them: mixing
+        chunks of two writes would decode garbage (the reference guards
+        with HashInfo comparison), so only the newest version holding >= k
+        chunks is used. `exclude_osds` keeps a recovery target's own stale
+        chunk out of its reconstruction. Raises StoreError ENOENT when no
+        shard exists anywhere, EIO when shards exist but no version is
+        decodable (transient: peers down/slow — NOT proof of deletion).
+        """
+        # per observed version: {shard: (chunk, ec_size, hinfo)}
+        by_version: dict[tuple, dict[int, tuple]] = {}
+
+        def add(shard: int, data: bytes, size: int, hd: dict, ver) -> None:
+            by_version.setdefault(tuple(ver), {})[shard] = (data, size, hd)
+
+        def best() -> tuple | None:
+            for ver in sorted(by_version, reverse=True):
+                if len(by_version[ver]) >= self.k:
+                    return ver
+            return None
+
+        if self.host.whoami not in exclude_osds and self.local_exists(oid):
+            data, attrs = self.read_for_push(oid)
+            add(int(attrs["shard"]), data, int(attrs["ec_size"]),
+                json.loads(attrs["hinfo"]),
+                json.loads(attrs.get("version", b"[0, 0]")))
+        waits: dict[asyncio.Future, int] = {}
+        for idx, osd in self._live_positions().items():
+            if osd == self.host.whoami or osd in exclude_osds:
+                continue
+            tid = self.new_tid()
+            fut = asyncio.get_running_loop().create_future()
+            self._read_waiters[tid] = fut
+            await self.host.send_osd(osd, MOSDECSubOpRead(
+                {"pgid": [self.pg.pgid.pool, self.pg.pgid.ps], "tid": tid,
+                 "from": self.host.whoami, "oid": oid}))
+            waits[fut] = tid
+        pending = set(waits)
+        deadline = asyncio.get_running_loop().time() + READ_TIMEOUT
+        try:
+            # early exit at k decodable chunks: one slow-but-up shard must
+            # not stall every read for the full timeout
+            while pending and best() is None:
+                timeout = deadline - asyncio.get_running_loop().time()
+                if timeout <= 0:
+                    break
+                done, pending = await asyncio.wait(
+                    pending, timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED)
+                for fut in done:
+                    payload, data = fut.result()
+                    if payload.get("found"):
+                        add(payload["shard"], data, payload["ec_size"],
+                            payload.get("hinfo") or {},
+                            payload.get("version", (0, 0)))
+        finally:
+            for fut, tid in waits.items():
+                fut.cancel()
+                self._read_waiters.pop(tid, None)
+        ver = best()
+        if ver is None:
+            if not by_version:
+                raise StoreError("ENOENT", f"{oid} has no shards anywhere")
+            raise StoreError(
+                "EIO", f"{oid}: no version has {self.k} shards "
+                f"(saw {({v: sorted(s) for v, s in by_version.items()})})")
+        shards = by_version[ver]
+        got = {shard: data for shard, (data, _, _) in shards.items()}
+        any_shard = next(iter(shards.values()))
+        return got, any_shard[1], {"hinfo": any_shard[2], "version": ver}
+
+    async def execute_read(self, oid: str, offset: int,
+                           length: int) -> bytes:
+        got, ec_size, _ = await self._gather_chunks(oid)
+        data = ec_util.decode_concat(self.sinfo, self.ec_impl, got)[:ec_size]
+        if length <= 0:
+            return data[offset:]
+        return data[offset:offset + length]
+
+    async def execute_stat(self, oid: str) -> int:
+        if self.local_exists(oid):
+            _, attrs = self.read_for_push(oid)
+            return int(attrs["ec_size"])
+        _, ec_size, _ = await self._gather_chunks(oid)
+        return ec_size
+
+    def object_size(self, oid: str) -> int:
+        _, attrs = self.read_for_push(oid)
+        return int(attrs["ec_size"])
+
+    # -- sub-op handlers (shard side) ----------------------------------------
+
+    async def handle_sub_op(self, conn, msg) -> None:
+        p = msg.payload
+        if isinstance(msg, MOSDECSubOpWrite):
+            attrs = ({k: v.encode("latin1") for k, v in p["attrs"].items()}
+                     if p.get("attrs") else None)
+            self._apply_chunk(p["oid"], p["op"], msg.data, attrs)
+            entry = LogEntry.from_dict(p["entry"])
+            if entry.version > self.pg.log.head:
+                self.pg.log.append(entry)
+            self.pg.log.mark_recovered(p["oid"])
+            self.pg.persist_meta()
+            conn.send_message(MOSDECSubOpWriteReply(
+                {"pgid": p["pgid"], "tid": p["tid"],
+                 "from": self.host.whoami}))
+            return
+        # sub-read: serve our chunk, crc-verified (ECBackend.cc:1092)
+        found = self.local_exists(p["oid"])
+        payload = {"pgid": p["pgid"], "tid": p["tid"],
+                   "from": self.host.whoami, "oid": p["oid"],
+                   "found": False, "shard": -1, "ec_size": -1}
+        data = b""
+        if found:
+            from ceph_tpu.native import ec_native
+            data, attrs = self.read_for_push(p["oid"])
+            shard = int(attrs["shard"])
+            hdict = json.loads(attrs["hinfo"])
+            hinfo = ec_util.HashInfo.from_dict(hdict)
+            have = ec_native.crc32c(data)
+            want = hinfo.get_chunk_hash(shard)
+            if have != want:
+                # a corrupt shard must not poison a decode: answer EIO
+                # (not-found) so the reader reconstructs from survivors
+                dout("osd", 1, f"ec shard {shard} of {p['oid']}: crc "
+                               f"mismatch {have:#x} != {want:#x} (EIO)")
+                data = b""
+            else:
+                payload.update({"found": True, "shard": shard,
+                                "ec_size": int(attrs["ec_size"]),
+                                "hinfo": hdict,
+                                "version": json.loads(
+                                    attrs.get("version", b"[0, 0]"))})
+        conn.send_message(MOSDECSubOpReadReply(payload, data))
+
+    def handle_sub_op_reply(self, msg) -> None:
+        p = msg.payload
+        if isinstance(msg, MOSDECSubOpWriteReply):
+            self.sub_op_ack(p["tid"], p["from"])
+            return
+        fut = self._read_waiters.get(p["tid"])
+        if fut is not None and not fut.done():
+            fut.set_result((p, msg.data))
+
+    # -- recovery (RecoveryOp-lite: reconstruct + push) ----------------------
+
+    async def _reconstruct(self, oid: str, idx: int,
+                           exclude: frozenset) -> tuple[bytes, dict] | None:
+        """Chunk for position `idx` + its attrs, reconstructed from any k
+        survivors (never from the target itself — its copy may be stale).
+        None ONLY on authoritative absence (ENOENT everywhere); transient
+        <k availability (EIO) propagates so peering retries instead of
+        recording a deletion."""
+        got, ec_size, meta = await self._gather_chunks(
+            oid, exclude_osds=exclude)
+        if idx in got:
+            chunk = got[idx]
+        else:
+            chunk = ec_util.decode_shards(self.sinfo, self.ec_impl,
+                                          got, [idx])[idx]
+        return chunk, self._chunk_attrs(idx, ec_size, meta["hinfo"],
+                                        meta["version"])
+
+    async def push_object(self, peer: int, oid: str) -> None:
+        """Reconstruct `peer`'s positional chunk from k survivors and
+        push it (the reference recovery reads min-to-decode and
+        re-encodes the missing shard, RecoveryOp ECBackend.h:191)."""
+        try:
+            idx = self.pg.acting.index(peer)
+        except ValueError:
+            return
+        try:
+            chunk, attrs = await self._reconstruct(
+                oid, idx, exclude=frozenset([peer]))
+        except StoreError as e:
+            if e.code != "ENOENT":
+                raise
+            await self.pg.send_push(peer, oid, b"", None, delete=True)
+            return
+        await self.pg.send_push(peer, oid, chunk, attrs, delete=False)
+
+    async def pull_object(self, auth_peer: int, oid: str, need) -> None:
+        """We (the primary) lack this object: reconstruct OUR positional
+        chunk from the survivors instead of copying the auth peer's (its
+        chunk is a different position)."""
+        me = self.pg.acting.index(self.host.whoami)
+        try:
+            chunk, attrs = await self._reconstruct(
+                oid, me, exclude=frozenset([self.host.whoami]))
+        except StoreError as e:
+            if e.code != "ENOENT":
+                raise
+            self.local_apply(oid, "delete", b"")
+            return
+        self.local_apply(oid, "push", chunk, attrs=attrs)

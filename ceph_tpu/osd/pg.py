@@ -231,7 +231,7 @@ class PGInstance:
             missing = self.log.merge_log(auth_entries, auth_head)
             self.seq = max(self.seq, self.log.head[1])
             for oid, need in missing.items():
-                await self._pull(auth_osd, oid, need)
+                await self.backend.pull_object(auth_osd, oid, need)
             self.log.clear_missing()
 
         # Activate: bring every replica to the authoritative state
@@ -245,10 +245,10 @@ class PGInstance:
                 if my_objects is None:
                     my_objects = self.list_objects()
                 for oid in my_objects:
-                    await self._push(peer, oid)
+                    await self.backend.push_object(peer, oid)
             else:
                 for oid in {e.oid for e in entries}:
-                    await self._push(peer, oid)
+                    await self.backend.push_object(peer, oid)
             await self.host.send_osd(peer, MOSDPGInfo(
                 {"pgid": pgid_key, "op": "activate", "epoch": epoch,
                  "from": self.host.whoami, "log": log_dict}))
@@ -259,8 +259,9 @@ class PGInstance:
         dout("osd", 3, f"osd.{self.host.whoami} pg {self.pgid} active "
                        f"(acting {self.acting}, head {self.log.head})")
 
-    async def _pull(self, peer: int, oid: str, need: Eversion) -> None:
-        """Fetch one object's authoritative state from `peer`."""
+    async def pull_transport(self, peer: int, oid: str) -> None:
+        """Fetch one object's state from `peer` (replicated pull; the EC
+        backend reconstructs instead — see ECBackend.pull_object)."""
         key = f"pull:{oid}"
         fut = asyncio.get_running_loop().create_future()
         self._push_waiters[key] = fut
@@ -272,21 +273,14 @@ class PGInstance:
         finally:
             self._push_waiters.pop(key, None)
 
-    async def _push(self, peer: int, oid: str) -> None:
-        """Push one object's local state (or its absence) to `peer`."""
-        shard = self.backend.shard_of(peer) \
-            if hasattr(self.backend, "shard_of") else -1
-        if self.backend.local_exists(oid, shard=shard):
-            data, attrs = self.backend.read_for_push(oid, shard=shard)
-            payload = {"pgid": [self.pgid.pool, self.pgid.ps], "op": "push",
-                       "from": self.host.whoami, "oid": oid, "delete": False,
-                       "attrs": {k: v.decode("latin1")
-                                 for k, v in attrs.items()}}
-            await self.host.send_osd(peer, MOSDPGPush(payload, data))
-        else:
-            await self.host.send_osd(peer, MOSDPGPush(
-                {"pgid": [self.pgid.pool, self.pgid.ps], "op": "push",
-                 "from": self.host.whoami, "oid": oid, "delete": True}))
+    async def send_push(self, peer: int, oid: str, data: bytes,
+                        attrs: dict | None, delete: bool) -> None:
+        payload = {"pgid": [self.pgid.pool, self.pgid.ps], "op": "push",
+                   "from": self.host.whoami, "oid": oid, "delete": delete}
+        if attrs:
+            payload["attrs"] = {k: v.decode("latin1")
+                                for k, v in attrs.items()}
+        await self.host.send_osd(peer, MOSDPGPush(payload, data))
 
     # -- peering message handlers (both roles) -------------------------------
 
@@ -305,13 +299,11 @@ class PGInstance:
 
     async def handle_push(self, conn, msg: MOSDPGPush) -> None:
         p = msg.payload
-        shard = self.backend.my_shard() \
-            if hasattr(self.backend, "my_shard") else -1
         if p["op"] == "pull":
             # serve the object back to the puller
             oid = p["oid"]
-            if self.backend.local_exists(oid, shard=shard):
-                data, attrs = self.backend.read_for_push(oid, shard=shard)
+            if self.backend.local_exists(oid):
+                data, attrs = self.backend.read_for_push(oid)
                 conn.send_message(MOSDPGPush(
                     {"pgid": p["pgid"], "op": "push",
                      "from": self.host.whoami, "oid": oid, "delete": False,
@@ -327,8 +319,7 @@ class PGInstance:
         # incoming object state
         attrs = {k: v.encode("latin1")
                  for k, v in p.get("attrs", {}).items()}
-        self.backend.apply_push(p["oid"], msg.data, attrs, p["delete"],
-                                shard=shard)
+        self.backend.apply_push(p["oid"], msg.data, attrs, p["delete"])
         self.log.mark_recovered(p["oid"])
         if p.get("reply_to") == "pull":
             fut = self._push_waiters.get(f"pull:{p['oid']}")
@@ -367,9 +358,7 @@ class PGInstance:
             self.persist_meta()
             return 0, {"version": list(version)}, b""
         if kind == "delete":
-            if not self.backend.local_exists(
-                    oid, shard=self.backend.my_shard()
-                    if hasattr(self.backend, "my_shard") else -1):
+            if not self.backend.local_exists(oid):
                 return -2, {"error": "ENOENT"}, b""
             version = self.next_version()
             entry = LogEntry(version=version, op="delete", oid=oid,
@@ -387,7 +376,7 @@ class PGInstance:
             return 0, {}, out
         if kind == "stat":
             try:
-                size = self.backend.object_size(oid)
+                size = await self.backend.execute_stat(oid)
             except StoreError as e:
                 return -2, {"error": str(e)}, b""
             return 0, {"size": size}, b""

@@ -140,8 +140,26 @@ async def smoke(n_mons: int, n_osds: int) -> dict:
             listed = await io.list_objects()
             if listed != [f"o{i}" for i in range(10)]:
                 raise AssertionError(f"bad listing: {listed}")
+            ec_note = "skipped (needs >= 3 osds)"
+            if n_osds >= 3:
+                await cl.command({
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "smokeprof",
+                    "profile": {"plugin": "jerasure", "k": "2", "m": "1",
+                                "technique": "reed_sol_van"}})
+                await cl.pool_create("smoke-ec", pg_num=4,
+                                     pool_type="erasure",
+                                     erasure_code_profile="smokeprof")
+                ecio = cl.ioctx("smoke-ec")
+                for i in range(5):
+                    await ecio.write_full(f"e{i}", bytes([i + 1]) * 9000)
+                for i in range(5):
+                    if await ecio.read(f"e{i}") != bytes([i + 1]) * 9000:
+                        raise AssertionError(f"ec readback e{i}")
+                ec_note = "ok: 5 striped objects wrote+read"
             status = c.status()
             status["smoke"] = "ok: 10 objects wrote+read+listed"
+            status["smoke_ec"] = ec_note
             return status
         finally:
             await c.stop()

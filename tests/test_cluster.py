@@ -178,6 +178,128 @@ def test_osd_death_cluster_survives(tmp_path):
     run(body())
 
 
+def test_ec_pool_end_to_end_and_degraded_read(tmp_path):
+    """k=2,m=1 erasure pool with the tpu plugin in situ: writes stripe
+    through the EC backend to positional shards; killing one shard OSD
+    still serves reads via reconstruct (minimum_to_decode + batched
+    decode), the reference test-erasure-code.sh contract."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.command({"prefix": "osd erasure-code-profile set",
+                              "name": "tpuprof",
+                              "profile": {"plugin": "tpu", "k": "2",
+                                          "m": "1"}})
+            await cl.pool_create("ecpool", pg_num=4, pool_type="erasure",
+                                 erasure_code_profile="tpuprof")
+            io = cl.ioctx("ecpool")
+            # 2-stripe objects (stripe_width = 2*4096): same jit shape
+            payloads = {f"e{i:02d}": bytes([i]) * 9000 for i in range(12)}
+            for oid, data in payloads.items():
+                await io.write_full(oid, data)
+            for oid, data in payloads.items():
+                assert await io.read(oid) == data
+            # each live osd holds chunk-shards, not whole objects
+            chunk = 4096
+            for osd in c.osds.values():
+                for pg in osd.pgs.values():
+                    for oid in pg.list_objects():
+                        got = osd.store.read(pg.backend.coll(),
+                                             pg.backend.ghobject(oid))
+                        assert len(got) % chunk == 0 and \
+                            len(got) < max(len(d) for d in payloads.values())
+            st = await io.stat("e03")
+            assert st["size"] == 9000
+            # degraded read: kill one shard osd, reads reconstruct
+            await c.kill_osd(2)
+            await c.wait_osd_down(2)
+            for oid, data in payloads.items():
+                assert await io.read(oid) == data, f"degraded read {oid}"
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_ec_recovery_reconstructs_lost_shards(tmp_path):
+    """k=2,m=2 over 4 osds: writes continue degraded (min_size=3) while
+    one osd is down; on restart, peering reconstructs its positional
+    chunks from survivors and pushes them (RecoveryOp semantics)."""
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=4)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.command({"prefix": "osd erasure-code-profile set",
+                              "name": "jprof",
+                              "profile": {"plugin": "jerasure", "k": "2",
+                                          "m": "2",
+                                          "technique": "reed_sol_van"}})
+            await cl.pool_create("ecpool", pg_num=4, pool_type="erasure",
+                                 erasure_code_profile="jprof")
+            io = cl.ioctx("ecpool")
+            for i in range(8):
+                await io.write_full(f"pre{i}", bytes([i + 1]) * 5000)
+            victim = c.osds[3]
+            store = victim.store
+            await c.kill_osd(3)
+            await c.wait_osd_down(3)
+            for i in range(8):   # degraded writes (3 of 4 shards live)
+                await io.write_full(f"deg{i}", bytes([i + 101]) * 5000)
+            for i in range(4):   # overwrites the dead osd must NOT keep
+                await io.write_full(f"pre{i}", bytes([i + 51]) * 6000)
+            await c.start_osd(3, store=store)
+            # recovery: osd.3 regains a chunk for every object in its PGs
+            deadline = asyncio.get_running_loop().time() + 25
+            while True:
+                osd = c.osds[3]
+                missing = []
+                for pg in osd.pgs.values():
+                    if osd.whoami not in pg.acting:
+                        continue
+                    primary = c.osds.get(pg.primary)
+                    if primary is None:
+                        continue
+                    ppg = primary.pgs.get(pg.pgid)
+                    if ppg is None:
+                        continue
+                    want = set(ppg.list_objects())
+                    have = set(pg.list_objects())
+                    missing.extend(want - have)
+                if not missing:
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError(f"ec recovery incomplete: "
+                                         f"{missing[:6]}")
+                await asyncio.sleep(0.2)
+            for i in range(8):
+                assert await io.read(f"deg{i}") == bytes([i + 101]) * 5000
+            for i in range(4):
+                assert await io.read(f"pre{i}") == bytes([i + 51]) * 6000
+            for i in range(4, 8):
+                assert await io.read(f"pre{i}") == bytes([i + 1]) * 5000
+            # the restarted osd's chunks must carry the overwrite's
+            # version, not its pre-death stale one (recovery must never
+            # hand a returning shard its own old chunk back)
+            import json as _json
+            osd3 = c.osds[3]
+            for pg in osd3.pgs.values():
+                for oid in pg.list_objects():
+                    if not oid.startswith("pre"):
+                        continue
+                    attrs = osd3.store.getattrs(pg.backend.coll(),
+                                                pg.backend.ghobject(oid))
+                    primary = c.osds[pg.primary]
+                    pattrs = primary.pgs[pg.pgid].backend.read_for_push(
+                        oid)[1]
+                    assert _json.loads(attrs["version"]) == \
+                        _json.loads(pattrs["version"]), oid
+        finally:
+            await c.stop()
+    run(body())
+
+
 def test_osd_restart_recovers_by_log(tmp_path):
     """Kill an osd, write while it is down, restart it with the same
     store: peering pushes it the writes it missed (log-driven recovery,
