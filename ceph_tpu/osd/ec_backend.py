@@ -104,11 +104,14 @@ class ECBackend(PGBackend):
         else:
             raise StoreError("EINVAL", f"unknown ec op {op!r}")
 
+        applied = 0
         for idx, osd in live.items():
             attrs, chunk = payloads[idx]
             if osd == self.host.whoami:
                 self._apply_chunk(oid, op, chunk, attrs)
-            else:
+                applied += 1
+                continue
+            try:
                 await self.host.send_osd(osd, MOSDECSubOpWrite(
                     {"pgid": [self.pg.pgid.pool, self.pg.pgid.ps],
                      "tid": tid, "from": self.host.whoami, "oid": oid,
@@ -117,6 +120,19 @@ class ECBackend(PGBackend):
                                 for k, v in attrs.items()}
                                if attrs else None),
                      "entry": entry.to_dict()}, chunk))
+                applied += 1
+            except Exception as e:
+                # unreachable peer the map hasn't caught up on: its shard
+                # goes missing (recovered by the next peering interval);
+                # the write still commits if min_size shards survive
+                dout("osd", 3, f"ec sub-write to osd.{osd} failed: "
+                               f"{type(e).__name__} {e}")
+                self.sub_op_ack(tid, osd)
+        if applied < self.pg.pool.min_size:
+            self.fail_inflight("ec write lost its min_size mid-fan-out")
+            raise IntervalChange(
+                f"only {applied} shards reachable < min_size "
+                f"{self.pg.pool.min_size}")
         await asyncio.wait_for(fut, SUBOP_TIMEOUT)
 
     def _apply_chunk(self, oid: str, op: str, chunk: bytes,
@@ -156,27 +172,68 @@ class ECBackend(PGBackend):
             return None
 
         if self.host.whoami not in exclude_osds and self.local_exists(oid):
+            from ceph_tpu.native import ec_native
             data, attrs = self.read_for_push(oid)
-            add(int(attrs["shard"]), data, int(attrs["ec_size"]),
-                json.loads(attrs["hinfo"]),
-                json.loads(attrs.get("version", b"[0, 0]")))
+            shard = int(attrs["shard"])
+            hd = json.loads(attrs["hinfo"])
+            # the coordinator's own chunk gets the same crc gate a remote
+            # sub-read would: local bit-rot must not poison the decode
+            want_crc = ec_util.HashInfo.from_dict(hd).get_chunk_hash(shard)
+            if ec_native.crc32c(data) == want_crc:
+                add(shard, data, int(attrs["ec_size"]), hd,
+                    json.loads(attrs.get("version", b"[0, 0]")))
+            else:
+                dout("osd", 1, f"ec local shard {shard} of {oid}: crc "
+                               f"mismatch, reconstructing around it")
+
+        # two rounds: ask a minimum set first (k shards total, preferring
+        # data positions), top up with the remaining positions only when
+        # the first round can't decode — the reference reads exactly
+        # minimum_to_decode and falls back to extra shards on miss
+        candidates = [(idx, osd)
+                      for idx, osd in sorted(self._live_positions().items())
+                      if osd != self.host.whoami
+                      and osd not in exclude_osds]
+        need_first = max(0, self.k - sum(len(v) for v in
+                                         by_version.values()))
+        rounds = [candidates[:need_first], candidates[need_first:]]
         waits: dict[asyncio.Future, int] = {}
-        for idx, osd in self._live_positions().items():
-            if osd == self.host.whoami or osd in exclude_osds:
-                continue
-            tid = self.new_tid()
-            fut = asyncio.get_running_loop().create_future()
-            self._read_waiters[tid] = fut
-            await self.host.send_osd(osd, MOSDECSubOpRead(
-                {"pgid": [self.pg.pgid.pool, self.pg.pgid.ps], "tid": tid,
-                 "from": self.host.whoami, "oid": oid}))
-            waits[fut] = tid
-        pending = set(waits)
         deadline = asyncio.get_running_loop().time() + READ_TIMEOUT
+
+        async def send_round(batch) -> set:
+            futs = set()
+            for idx, osd in batch:
+                tid = self.new_tid()
+                fut = asyncio.get_running_loop().create_future()
+                self._read_waiters[tid] = fut
+                waits[fut] = tid
+                try:
+                    await self.host.send_osd(osd, MOSDECSubOpRead(
+                        {"pgid": [self.pg.pgid.pool, self.pg.pgid.ps],
+                         "tid": tid, "from": self.host.whoami, "oid": oid}))
+                    futs.add(fut)
+                except Exception as e:
+                    # unreachable peer: just a missing chunk, not a failed
+                    # read — the top-up round covers it
+                    dout("osd", 3, f"ec sub-read to osd.{osd} failed: "
+                                   f"{type(e).__name__} {e}")
+                    fut.cancel()
+            return futs
+
         try:
+            pending = await send_round(rounds[0])
+            topped_up = False
             # early exit at k decodable chunks: one slow-but-up shard must
             # not stall every read for the full timeout
-            while pending and best() is None:
+            while True:
+                if best() is None and not topped_up and (
+                        not pending or
+                        len(pending) + sum(len(v) for v in
+                                           by_version.values()) < self.k):
+                    pending |= await send_round(rounds[1])
+                    topped_up = True
+                if not pending or best() is not None:
+                    break
                 timeout = deadline - asyncio.get_running_loop().time()
                 if timeout <= 0:
                     break
