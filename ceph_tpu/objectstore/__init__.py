@@ -1,8 +1,11 @@
-"""Local object stores (ObjectStore API, MemStore test double)."""
+"""Local object stores: ObjectStore API, MemStore (test double), and
+FileStore (persistent: WAL + crc-verified blobs + checkpointed meta)."""
 from ceph_tpu.objectstore.types import Ghobject, CollectionId
 from ceph_tpu.objectstore.store import (ObjectStore, StoreError, Transaction,
                                         NO_SHARD)
 from ceph_tpu.objectstore.memstore import MemStore
+from ceph_tpu.objectstore.filestore import FileStore, SimulatedCrash
 
 __all__ = ["Ghobject", "CollectionId", "ObjectStore", "StoreError",
-           "Transaction", "MemStore", "NO_SHARD"]
+           "Transaction", "MemStore", "FileStore", "SimulatedCrash",
+           "NO_SHARD"]

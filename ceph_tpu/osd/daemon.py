@@ -28,6 +28,7 @@ from ceph_tpu.msg.messages import (Message, MOSDOp, MOSDOpReply, MOSDPGInfo,
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
 from ceph_tpu.mon.mon_client import MonClient
 from ceph_tpu.objectstore.memstore import MemStore
+from ceph_tpu.objectstore.store import StoreError
 from ceph_tpu.osd.backend import IntervalChange
 from ceph_tpu.osd.pg import PGInstance
 from ceph_tpu.utils.dout import dout
@@ -64,7 +65,12 @@ class OSD(Dispatcher):
     async def start(self, timeout: float = 30.0) -> tuple[str, int]:
         try:
             self.store.mount()
-        except Exception:
+        except StoreError as e:
+            # ONLY an uninitialized store may be formatted — any other
+            # mount failure (corrupt meta, IO error) must not silently
+            # wipe a durable store
+            if e.code != "ENOENT":
+                raise
             self.store.mkfs()
             self.store.mount()
         self.addr = await self.messenger.bind("127.0.0.1", 0)

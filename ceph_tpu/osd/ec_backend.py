@@ -223,20 +223,33 @@ class ECBackend(PGBackend):
         try:
             pending = await send_round(rounds[0])
             topped_up = False
+            half = deadline - READ_TIMEOUT / 2
             # early exit at k decodable chunks: one slow-but-up shard must
             # not stall every read for the full timeout
             while True:
+                now = asyncio.get_running_loop().time()
+                # top up when the minimum round can no longer decode on
+                # its own: chunks of DIFFERENT versions don't combine, so
+                # count the best single version, not the cross-version
+                # sum; a half-spent deadline also triggers the top-up
+                # (slow peer + stale local chunk could otherwise starve
+                # a servable read)
+                have_best = max((len(v) for v in by_version.values()),
+                                default=0)
                 if best() is None and not topped_up and (
-                        not pending or
-                        len(pending) + sum(len(v) for v in
-                                           by_version.values()) < self.k):
+                        not pending
+                        or len(pending) + have_best < self.k
+                        or now > half):
                     pending |= await send_round(rounds[1])
                     topped_up = True
                 if not pending or best() is not None:
                     break
-                timeout = deadline - asyncio.get_running_loop().time()
+                wake = deadline if topped_up else min(deadline, half)
+                timeout = wake - asyncio.get_running_loop().time()
                 if timeout <= 0:
-                    break
+                    if topped_up:
+                        break
+                    continue    # hit the half mark: run the top-up branch
                 done, pending = await asyncio.wait(
                     pending, timeout=timeout,
                     return_when=asyncio.FIRST_COMPLETED)

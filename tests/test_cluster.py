@@ -43,7 +43,8 @@ def fast_timers(monkeypatch):
 class ClusterHarness:
     """run_mon + run_osd equivalent (qa/standalone/ceph-helpers.sh)."""
 
-    def __init__(self, tmp_path, n_mons: int = 1, n_osds: int = 3):
+    def __init__(self, tmp_path, n_mons: int = 1, n_osds: int = 3,
+                 store_factory=None):
         ports = free_ports(n_mons)
         self.monmap = MonMap({f"m{i}": ("127.0.0.1", ports[i])
                               for i in range(n_mons)})
@@ -51,6 +52,7 @@ class ClusterHarness:
         self.mons: dict[str, Monitor] = {}
         self.osds: dict[int, OSD] = {}
         self.n_osds = n_osds
+        self.store_factory = store_factory
         self.clients: list[RadosClient] = []
 
     @property
@@ -74,6 +76,8 @@ class ClusterHarness:
             await self.start_osd(i)
 
     async def start_osd(self, i: int, store=None) -> OSD:
+        if store is None and self.store_factory is not None:
+            store = self.store_factory(i)
         osd = OSD(i, self.mon_addrs, store=store)
         self.osds[i] = osd
         await osd.start()
@@ -300,12 +304,20 @@ def test_ec_recovery_reconstructs_lost_shards(tmp_path):
     run(body())
 
 
-def test_osd_restart_recovers_by_log(tmp_path):
+@pytest.mark.parametrize("backend", ["memstore", "filestore"])
+def test_osd_restart_recovers_by_log(tmp_path, backend):
     """Kill an osd, write while it is down, restart it with the same
     store: peering pushes it the writes it missed (log-driven recovery,
-    PGLog::merge_log semantics) and it serves reads again."""
+    PGLog::merge_log semantics) and it serves reads again. With the
+    filestore backend the restart builds a FRESH store instance on the
+    same directory — true process-restart semantics (checkpoint + WAL
+    replay feeding PG meta/log recovery)."""
+    from ceph_tpu.objectstore import FileStore
+    factory = (lambda i: FileStore(str(tmp_path / f"osd{i}"))) \
+        if backend == "filestore" else None
+
     async def body():
-        c = ClusterHarness(tmp_path)
+        c = ClusterHarness(tmp_path, store_factory=factory)
         try:
             await c.start()
             cl = await c.client()
@@ -323,7 +335,7 @@ def test_osd_restart_recovers_by_log(tmp_path):
             for i in range(10):
                 await io.write_full(f"b{i:02d}", b"new" + bytes([i]))
             # restart from the surviving store: boots, re-peers, recovers
-            await c.start_osd(1, store=store)
+            await c.start_osd(1, store=(factory(1) if factory else store))
             deadline = asyncio.get_running_loop().time() + 20
             while True:
                 osd = c.osds[1]
