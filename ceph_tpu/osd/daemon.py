@@ -31,7 +31,10 @@ from ceph_tpu.objectstore.memstore import MemStore
 from ceph_tpu.objectstore.store import StoreError
 from ceph_tpu.osd.backend import IntervalChange
 from ceph_tpu.osd.pg import PGInstance
+from ceph_tpu.utils.admin_socket import AdminSocket
 from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.throttle import HeartbeatMap
+from ceph_tpu.utils.work_queue import Finisher, OpTracker, ShardedOpQueue
 
 
 class OSD(Dispatcher):
@@ -40,11 +43,46 @@ class OSD(Dispatcher):
     HB_INTERVAL = 1.0
     HB_GRACE = 3.0              # osd_heartbeat_grace analog
 
+    NUM_OP_SHARDS = 5           # osd_op_num_shards analog
+
     def __init__(self, whoami: int, mon_addrs: list[tuple[str, int]],
-                 store=None, crush_location: dict | None = None):
+                 store=None, crush_location: dict | None = None,
+                 admin_socket_path: str | None = None):
         self.whoami = whoami
         self.store = store if store is not None else MemStore(f"osd{whoami}")
         self.crush_location = crush_location or {"host": f"host{whoami}"}
+        # op execution substrate: sharded queue (per-PG order, cross-PG
+        # concurrency) + finisher for completions + per-op tracking
+        self.hb_map = HeartbeatMap()
+        self.optracker = OpTracker()
+        self.op_queue = ShardedOpQueue(f"osd.{whoami}.op_tp",
+                                       num_shards=self.NUM_OP_SHARDS,
+                                       hb_map=self.hb_map)
+        self.finisher = Finisher(f"osd.{whoami}.finisher",
+                                 hb_map=self.hb_map)
+        self.asok: AdminSocket | None = None
+        if admin_socket_path:
+            self.asok = AdminSocket(admin_socket_path)
+            self.asok.register_command(
+                "dump_ops_in_flight",
+                lambda req: self.optracker.dump_ops_in_flight(),
+                "ops currently being processed")
+            self.asok.register_command(
+                "dump_historic_ops",
+                lambda req: self.optracker.dump_historic_ops(),
+                "recently completed ops with event timelines")
+            self.asok.register_command(
+                "dump_historic_slow_ops",
+                lambda req: self.optracker.dump_historic_slow_ops(),
+                "recently completed slow ops")
+            self.asok.register_command(
+                "status", lambda req: {
+                    "whoami": self.whoami,
+                    "osdmap_epoch": self.osdmap.epoch,
+                    "num_pgs": len(self.pgs),
+                    "hb_healthy": self.hb_map.is_healthy()[0],
+                    "ops_processed": self.op_queue.processed},
+                "daemon status")
         self.messenger = Messenger(f"osd.{whoami}")
         self.messenger.add_dispatcher(self)
         self.monc = MonClient(self.messenger, mon_addrs)
@@ -73,6 +111,10 @@ class OSD(Dispatcher):
                 raise
             self.store.mkfs()
             self.store.mount()
+        self.op_queue.start()
+        self.finisher.start()
+        if self.asok is not None:
+            self.asok.start()
         self.addr = await self.messenger.bind("127.0.0.1", 0)
         await self.monc.start()
         self.monc.subscribe("osdmap", 1)
@@ -120,6 +162,10 @@ class OSD(Dispatcher):
         for pg in self.pgs.values():
             pg._cancel_peering()
             pg.backend.fail_inflight("osd stopping")
+        await self.op_queue.stop()
+        await self.finisher.stop()
+        if self.asok is not None:
+            self.asok.stop()
         await self.monc.close()
         await self.messenger.shutdown()
         self.store.umount()
