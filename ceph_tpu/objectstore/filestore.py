@@ -39,6 +39,14 @@ class SimulatedCrash(Exception):
     durable but before apply — the window BlueStore's replay covers."""
 
 
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def _crc32c(data: bytes) -> int:
     from ceph_tpu.native import ec_native
     return ec_native.crc32c(data)
@@ -162,6 +170,10 @@ class FileStore(MemStore):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.ckpt_path)
+        # the rename itself must be durable before the WAL is truncated,
+        # or a power loss loses transactions whose on_commit already fired
+        # (the reference fsyncs the containing dir after every rename)
+        _fsync_dir(self.path)
         self._ckpt_seq = self._seq
 
     def _checkpoint(self) -> None:
@@ -174,6 +186,10 @@ class FileStore(MemStore):
                     os.fsync(fd)
                 finally:
                     os.close(fd)
+        if self._dirty_blobs:
+            # newly created blob files' directory entries must be durable
+            # too, or replay finds the checkpoint pointing at nothing
+            _fsync_dir(self.blob_dir)
         self._dirty_blobs.clear()
         self._write_checkpoint()
         if self._wal_f is not None:

@@ -135,6 +135,12 @@ class PGBackend:
     async def execute_read(self, oid: str, offset: int, length: int) -> bytes:
         raise NotImplementedError
 
+    async def object_exists(self, oid: str) -> bool:
+        """Whether the object logically exists in this PG. The EC backend
+        overrides: the primary's own positional chunk can be missing or
+        corrupt while >= k shards exist on peers (ADVICE r4)."""
+        return self.local_exists(oid)
+
     def object_size(self, oid: str) -> int:
         raise NotImplementedError
 

@@ -104,12 +104,11 @@ class ECBackend(PGBackend):
         else:
             raise StoreError("EINVAL", f"unknown ec op {op!r}")
 
-        applied = 0
+        failed = []
         for idx, osd in live.items():
             attrs, chunk = payloads[idx]
             if osd == self.host.whoami:
                 self._apply_chunk(oid, op, chunk, attrs)
-                applied += 1
                 continue
             try:
                 await self.host.send_osd(osd, MOSDECSubOpWrite(
@@ -120,19 +119,22 @@ class ECBackend(PGBackend):
                                 for k, v in attrs.items()}
                                if attrs else None),
                      "entry": entry.to_dict()}, chunk))
-                applied += 1
             except Exception as e:
-                # unreachable peer the map hasn't caught up on: its shard
-                # goes missing (recovered by the next peering interval);
-                # the write still commits if min_size shards survive
+                # an unreachable peer the map hasn't caught up on: the
+                # write must NOT be acked with a subset of live shards —
+                # a fake ack here lets an acked write become undecodable
+                # after m more failures (ADVICE r4). Fail the op; the
+                # client retries until heartbeats push the peer out of
+                # the acting set (the reference blocks degraded EC writes
+                # the same way).
                 dout("osd", 3, f"ec sub-write to osd.{osd} failed: "
                                f"{type(e).__name__} {e}")
-                self.sub_op_ack(tid, osd)
-        if applied < self.pg.pool.min_size:
-            self.fail_inflight("ec write lost its min_size mid-fan-out")
+                failed.append(osd)
+        if failed:
+            self._inflight.pop(tid, None)
             raise IntervalChange(
-                f"only {applied} shards reachable < min_size "
-                f"{self.pg.pool.min_size}")
+                f"ec sub-writes to osds {failed} failed; "
+                f"retry next interval")
         await asyncio.wait_for(fut, SUBOP_TIMEOUT)
 
     def _apply_chunk(self, oid: str, op: str, chunk: bytes,
@@ -147,6 +149,7 @@ class ECBackend(PGBackend):
     async def _gather_chunks(
             self, oid: str,
             exclude_osds: frozenset = frozenset(),
+            allow_rollback: bool = False,
     ) -> tuple[dict[int, bytes], int, dict]:
         """Collect shard chunks until a version-consistent decodable set
         exists; returns ({shard: chunk}, logical size, hinfo dict).
@@ -158,6 +161,14 @@ class ECBackend(PGBackend):
         chunk out of its reconstruction. Raises StoreError ENOENT when no
         shard exists anywhere, EIO when shards exist but no version is
         decodable (transient: peers down/slow — NOT proof of deletion).
+
+        If a NEWER version than the best decodable one was observed, the
+        default is EIO (serving the older version would roll back a
+        possibly-acked write). Recovery passes `allow_rollback=True`: a
+        partial never-acked fan-out must not wedge peering forever, so
+        the divergent suffix is rewound to the older consistent version
+        (the reference's peering rewinds uncommitted divergent entries
+        the same way); meta["rolled_back"] reports it.
         """
         # per observed version: {shard: (chunk, ec_size, hinfo)}
         by_version: dict[tuple, dict[int, tuple]] = {}
@@ -270,10 +281,28 @@ class ECBackend(PGBackend):
             raise StoreError(
                 "EIO", f"{oid}: no version has {self.k} shards "
                 f"(saw {({v: sorted(s) for v, s in by_version.items()})})")
+        newest = max(by_version)
+        rolled_back = False
+        if newest > ver:
+            # a NEWER committed write exists but is currently undecodable:
+            # serving the older decodable version would silently roll back
+            # an acked write — answer EIO until recovery restores it
+            # (ADVICE r4; the reference's rollforward machinery guarantees
+            # the same by never exposing a pre-rollforward state)
+            if not allow_rollback:
+                raise StoreError(
+                    "EIO", f"{oid}: newest version {newest} has only "
+                    f"{len(by_version[newest])} of {self.k} shards; "
+                    f"refusing to serve older {ver}")
+            rolled_back = True
+            dout("osd", 1, f"ec {oid}: rolling divergent partial write "
+                           f"{newest} ({len(by_version[newest])} shards) "
+                           f"back to {ver}")
         shards = by_version[ver]
         got = {shard: data for shard, (data, _, _) in shards.items()}
         any_shard = next(iter(shards.values()))
-        return got, any_shard[1], {"hinfo": any_shard[2], "version": ver}
+        return got, any_shard[1], {"hinfo": any_shard[2], "version": ver,
+                                   "rolled_back": rolled_back}
 
     async def execute_read(self, oid: str, offset: int,
                            length: int) -> bytes:
@@ -282,6 +311,17 @@ class ECBackend(PGBackend):
         if length <= 0:
             return data[offset:]
         return data[offset:offset + length]
+
+    async def object_exists(self, oid: str) -> bool:
+        if self.local_exists(oid):
+            return True
+        try:
+            await self._gather_chunks(oid)
+            return True
+        except StoreError as e:
+            # EIO = shards exist but are (transiently) undecodable: the
+            # object exists; only authoritative absence is False
+            return e.code != "ENOENT"
 
     async def execute_stat(self, oid: str) -> int:
         if self.local_exists(oid):
@@ -350,15 +390,35 @@ class ECBackend(PGBackend):
 
     # -- recovery (RecoveryOp-lite: reconstruct + push) ----------------------
 
+    async def _rewrite_consistent(self, oid: str, got: dict[int, bytes],
+                                  ec_size: int) -> None:
+        """Converge every live shard on one consistent state by
+        re-asserting the rolled-back content as a fresh full write: a
+        divergent partial fan-out leaves SOME shards at the newer
+        version, and reconstructing just one position would leave the
+        acting set mixed (every later read would EIO)."""
+        data = ec_util.decode_concat(self.sinfo, self.ec_impl,
+                                     got)[:ec_size]
+        version = self.pg.next_version()
+        entry = LogEntry(version=version, op="modify", oid=oid,
+                         prior_version=self.pg._prior(oid))
+        await self.execute_write(oid, "write_full", data, entry)
+        self.pg.log.append(entry)
+        self.pg.persist_meta()
+
     async def _reconstruct(self, oid: str, idx: int,
                            exclude: frozenset) -> tuple[bytes, dict] | None:
         """Chunk for position `idx` + its attrs, reconstructed from any k
         survivors (never from the target itself — its copy may be stale).
-        None ONLY on authoritative absence (ENOENT everywhere); transient
-        <k availability (EIO) propagates so peering retries instead of
-        recording a deletion."""
+        None when the acting set was instead converged by a divergence
+        rewrite (the caller's push is already done). Transient <k
+        availability (EIO with no rollback possible) propagates so
+        peering retries instead of recording a deletion."""
         got, ec_size, meta = await self._gather_chunks(
-            oid, exclude_osds=exclude)
+            oid, exclude_osds=exclude, allow_rollback=True)
+        if meta["rolled_back"]:
+            await self._rewrite_consistent(oid, got, ec_size)
+            return None
         if idx in got:
             chunk = got[idx]
         else:
@@ -376,13 +436,16 @@ class ECBackend(PGBackend):
         except ValueError:
             return
         try:
-            chunk, attrs = await self._reconstruct(
-                oid, idx, exclude=frozenset([peer]))
+            rec = await self._reconstruct(oid, idx,
+                                          exclude=frozenset([peer]))
         except StoreError as e:
             if e.code != "ENOENT":
                 raise
             await self.pg.send_push(peer, oid, b"", None, delete=True)
             return
+        if rec is None:
+            return      # divergence rewrite already updated every shard
+        chunk, attrs = rec
         await self.pg.send_push(peer, oid, chunk, attrs, delete=False)
 
     async def pull_object(self, auth_peer: int, oid: str, need) -> None:
@@ -391,11 +454,14 @@ class ECBackend(PGBackend):
         chunk is a different position)."""
         me = self.pg.acting.index(self.host.whoami)
         try:
-            chunk, attrs = await self._reconstruct(
+            rec = await self._reconstruct(
                 oid, me, exclude=frozenset([self.host.whoami]))
         except StoreError as e:
             if e.code != "ENOENT":
                 raise
             self.local_apply(oid, "delete", b"")
             return
+        if rec is None:
+            return      # divergence rewrite already updated every shard
+        chunk, attrs = rec
         self.local_apply(oid, "push", chunk, attrs=attrs)

@@ -137,13 +137,19 @@ class PGInstance:
         """New osdmap epoch: if the acting set changed, re-peer
         (the reference starts a new peering interval, PeeringState
         advance_map/start_peering_interval)."""
-        if acting == self.acting and self.state in ("active", "replica"):
-            return
+        if acting == self.acting:
+            if self.state in ("active", "replica"):
+                return
+            if (self.state == "peering" and self._peer_task is not None
+                    and not self._peer_task.done()):
+                # same interval, peering already in flight: a second task
+                # would clobber the first's _peer_waiters (ADVICE r4)
+                return
         interval_changed = acting != self.acting
         self.up, self.acting = list(up), list(acting)
         if interval_changed:
             self.backend.fail_inflight("peering interval change")
-            self._cancel_peering()
+        self._cancel_peering()
         if self.host.whoami not in self.acting:
             self.state = "stray"
             self._active_event.clear()
@@ -228,11 +234,20 @@ class PGInstance:
             # GetMissing: merge the authoritative log, pull what we lack
             auth = replies[auth_osd]
             auth_entries = [LogEntry.from_dict(e) for e in auth["entries"]]
-            missing = self.log.merge_log(auth_entries, auth_head)
-            self.seq = max(self.seq, self.log.head[1])
-            for oid, need in missing.items():
-                await self.backend.pull_object(auth_osd, oid, need)
-            self.log.clear_missing()
+            auth_tail = tuple(auth["info"]["log_tail"])
+            if auth_tail > self.log.head:
+                # we are behind the auth's log TAIL: its retained entries
+                # cannot bridge our gap, and a plain merge would silently
+                # lose every write older than the window (ADVICE r4) —
+                # backfill the full authoritative object set instead
+                await self._backfill_from(auth_osd, auth_entries,
+                                          auth_head, auth_tail)
+            else:
+                missing = self.log.merge_log(auth_entries, auth_head)
+                self.seq = max(self.seq, self.log.head[1])
+                for oid, need in missing.items():
+                    await self.backend.pull_object(auth_osd, oid, need)
+                self.log.clear_missing()
 
         # Activate: bring every replica to the authoritative state
         log_dict = self.log.to_dict()
@@ -240,24 +255,66 @@ class PGInstance:
         for peer, rep in replies.items():
             peer_head = tuple(rep["info"]["last_update"])
             entries = self.log.entries_since(peer_head)
+            act_payload = {"pgid": pgid_key, "op": "activate",
+                           "epoch": epoch, "from": self.host.whoami,
+                           "log": log_dict}
             if entries is None:
-                # peer is behind the log tail: backfill everything
+                # peer is behind the log tail: backfill everything, and
+                # ship the authoritative object list so the replica can
+                # drop strays (deletes it missed past the log window
+                # would otherwise resurrect if it later became primary)
                 if my_objects is None:
                     my_objects = self.list_objects()
                 for oid in my_objects:
                     await self.backend.push_object(peer, oid)
+                act_payload["objects"] = my_objects
             else:
                 for oid in {e.oid for e in entries}:
                     await self.backend.push_object(peer, oid)
-            await self.host.send_osd(peer, MOSDPGInfo(
-                {"pgid": pgid_key, "op": "activate", "epoch": epoch,
-                 "from": self.host.whoami, "log": log_dict}))
+            await self.host.send_osd(peer, MOSDPGInfo(act_payload))
         self.last_epoch_started = epoch
         self.persist_meta()
         self.state = "active"
         self._active_event.set()
         dout("osd", 3, f"osd.{self.host.whoami} pg {self.pgid} active "
                        f"(acting {self.acting}, head {self.log.head})")
+
+    async def _backfill_from(self, auth_osd: int, auth_entries, auth_head,
+                             auth_tail) -> None:
+        """Full-resync path for a primary behind the auth peer's log tail:
+        adopt the auth log wholesale, pull every object the auth holds,
+        delete local strays (the reference falls through to backfill when
+        `entries_since` cannot bridge the gap, PGLog.h:1254)."""
+        fut = asyncio.get_running_loop().create_future()
+        self._peer_waiters[auth_osd] = fut
+        try:
+            await self.host.send_osd(auth_osd, MOSDPGQuery(
+                {"pgid": [self.pgid.pool, self.pgid.ps],
+                 "from": self.host.whoami,
+                 "epoch": self.host.osdmap.epoch, "want": "objects"}))
+            reply = await asyncio.wait_for(fut, PEER_TIMEOUT)
+        except asyncio.TimeoutError:
+            raise PeerSilent(f"auth peer {auth_osd} silent during backfill")
+        finally:
+            self._peer_waiters.pop(auth_osd, None)
+        if "objects" not in reply:
+            # a stale reply from an earlier peering round can resolve this
+            # waiter (handle_log matches on peer, not round); treating it
+            # as an empty object set would delete every local object
+            raise PeerSilent(
+                f"auth peer {auth_osd} answered backfill query without "
+                f"an object list (stale reply)")
+        auth_objects = set(reply["objects"])
+        for oid in sorted(auth_objects):
+            await self.backend.pull_object(auth_osd, oid, None)
+        for oid in self.list_objects():
+            if oid not in auth_objects:
+                self.backend.local_apply(oid, "delete", b"")
+        new_log = PGLog()
+        new_log.entries = list(auth_entries)
+        new_log.head, new_log.tail = auth_head, auth_tail
+        self.log = new_log
+        self.seq = max(self.seq, auth_head[1])
 
     async def pull_transport(self, peer: int, oid: str) -> None:
         """Fetch one object's state from `peer` (replicated pull; the EC
@@ -285,11 +342,15 @@ class PGInstance:
     # -- peering message handlers (both roles) -------------------------------
 
     async def handle_query(self, conn, msg: MOSDPGQuery) -> None:
-        """A primary wants our info + log (GetInfo+GetLog combined)."""
-        conn.send_message(MOSDPGLog(
-            {"pgid": [self.pgid.pool, self.pgid.ps],
-             "from": self.host.whoami, "info": self.info(),
-             "entries": [e.to_dict() for e in self.log.entries]}))
+        """A primary wants our info + log (GetInfo+GetLog combined);
+        `want: objects` additionally returns the collection listing (the
+        backfill scan)."""
+        payload = {"pgid": [self.pgid.pool, self.pgid.ps],
+                   "from": self.host.whoami, "info": self.info(),
+                   "entries": [e.to_dict() for e in self.log.entries]}
+        if msg.payload.get("want") == "objects":
+            payload["objects"] = self.list_objects()
+        conn.send_message(MOSDPGLog(payload))
 
     def handle_log(self, msg: MOSDPGLog) -> None:
         peer = msg.payload["from"]
@@ -333,6 +394,13 @@ class PGInstance:
     def handle_activate(self, msg: MOSDPGInfo) -> None:
         """Primary says: adopt this log, you are consistent now."""
         p = msg.payload
+        if "objects" in p:
+            # backfill activation: anything we hold outside the
+            # authoritative set is a stray from before our outage
+            auth_objects = set(p["objects"])
+            for oid in self.list_objects():
+                if oid not in auth_objects:
+                    self.backend.local_apply(oid, "delete", b"")
         auth = PGLog.from_dict(p["log"])
         self.log = auth
         self.log.clear_missing()
@@ -358,7 +426,7 @@ class PGInstance:
             self.persist_meta()
             return 0, {"version": list(version)}, b""
         if kind == "delete":
-            if not self.backend.local_exists(oid):
+            if not await self.backend.object_exists(oid):
                 return -2, {"error": "ENOENT"}, b""
             version = self.next_version()
             entry = LogEntry(version=version, op="delete", oid=oid,

@@ -363,3 +363,64 @@ def test_osd_restart_recovers_by_log(tmp_path, backend):
         finally:
             await c.stop()
     run(body())
+
+
+def test_primary_behind_log_tail_backfills(tmp_path, monkeypatch):
+    """A restarted primary whose log head predates the auth peer's log
+    TAIL must backfill the full object set instead of trusting a merge
+    that cannot see the missed window (ADVICE r4: silent write loss).
+    Deletes that happened while it was down must also take effect."""
+    from ceph_tpu.osd.pglog import PGLog
+    monkeypatch.setattr(PGLog, "MAX_ENTRIES", 8)
+
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=1, size=3)
+            io = cl.ioctx("rbd")
+            for i in range(5):
+                await io.write_full(f"o{i}", b"v1-" + bytes([i]))
+            from ceph_tpu.crush.osdmap import PG as PGId
+            pool = cl.osdmap.get_pool("rbd")
+            victim = cl.osdmap.primary(PGId(pool.id, 0))
+            store = c.osds[victim].store
+            await c.kill_osd(victim)
+            await c.wait_osd_down(victim)
+            # slide the survivors' log window far past the victim's head:
+            # > MAX_ENTRIES writes, including overwrites, fresh objects,
+            # and a delete
+            await io.remove("o0")
+            for r in range(3):
+                for i in range(1, 5):
+                    await io.write_full(f"o{i}", b"v2-%d-" % r + bytes([i]))
+            for i in range(6):
+                await io.write_full(f"n{i}", b"new-" + bytes([i]))
+            await c.start_osd(victim, store=store)
+            deadline = asyncio.get_running_loop().time() + 25
+            want = {f"o{i}" for i in range(1, 5)} | {f"n{i}" for i in range(6)}
+            while True:
+                osd = c.osds[victim]
+                pgs = [pg for pg in osd.pgs.values()
+                       if pg.state == "active" and pg.is_primary()]
+                have = {oid for pg in pgs for oid in pg.list_objects()}
+                if pgs and have == want:
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError(
+                        f"backfill wrong: have={sorted(have)} "
+                        f"want={sorted(want)} "
+                        f"states={[pg.state for pg in osd.pgs.values()]}")
+                await asyncio.sleep(0.2)
+            # client-visible state is the authoritative one
+            assert sorted(await io.list_objects()) == sorted(want)
+            for i in range(1, 5):
+                assert (await io.read(f"o{i}")).startswith(b"v2-2-")
+            import pytest as _pytest
+            from ceph_tpu.rados import ObjectNotFound
+            with _pytest.raises(ObjectNotFound):
+                await io.read("o0")
+        finally:
+            await c.stop()
+    run(body())
