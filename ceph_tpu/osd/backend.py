@@ -16,6 +16,7 @@ sub-op acks resolve asyncio futures instead of Context callbacks.
 from __future__ import annotations
 
 import asyncio
+import json
 from typing import TYPE_CHECKING
 
 from ceph_tpu.crush.crush import CRUSH_NONE
@@ -100,7 +101,7 @@ class PGBackend:
 
     def local_apply(self, oid: str, op: str, data: bytes,
                     attrs: dict[str, bytes] | None = None,
-                    shard: int = -1) -> None:
+                    shard: int = -1, off: int = 0) -> None:
         cid = self.coll(shard)
         gh = self.ghobject(oid, shard)
         txn = Transaction()
@@ -111,6 +112,46 @@ class PGBackend:
             txn.write(cid, gh, 0, data)
             if attrs:
                 txn.setattrs(cid, gh, attrs)
+        elif op == "write":
+            if not self.host.store.exists(cid, gh):
+                txn.touch(cid, gh)
+            txn.write(cid, gh, off, data)
+        elif op == "truncate":
+            if not self.host.store.exists(cid, gh):
+                txn.touch(cid, gh)
+            txn.truncate(cid, gh, off)
+        elif op == "zero":
+            # data carries the length as decimal bytes (ops re-execute on
+            # replicas; zero has no payload of its own)
+            if not self.host.store.exists(cid, gh):
+                txn.touch(cid, gh)
+            txn.zero(cid, gh, off, int(data))
+        elif op == "create":
+            txn.touch(cid, gh)
+        elif op == "setxattr":
+            kv = json.loads(data)
+            if not self.host.store.exists(cid, gh):
+                txn.touch(cid, gh)
+            txn.setattrs(cid, gh,
+                         {"u:" + kv["name"]:
+                          kv["value"].encode("latin1")})
+        elif op == "rmxattr":
+            name = "u:" + data.decode()
+            try:
+                self.host.store.getattr(cid, gh, name)
+            except StoreError:
+                pass        # absent attr (or object): rm is a no-op
+            else:
+                txn.rmattr(cid, gh, name)
+        elif op == "omap_set":
+            kv = json.loads(data)
+            if not self.host.store.exists(cid, gh):
+                txn.touch(cid, gh)
+            txn.omap_setkeys(cid, gh, {k: v.encode("latin1")
+                                       for k, v in kv.items()})
+        elif op == "omap_rm":
+            if self.host.store.exists(cid, gh):
+                txn.omap_rmkeys(cid, gh, json.loads(data))
         elif op in ("delete", "remove"):
             if self.host.store.exists(cid, gh):
                 txn.remove(cid, gh)
@@ -129,7 +170,7 @@ class PGBackend:
     # -- interface subclasses implement --------------------------------------
 
     async def execute_write(self, oid: str, op: str, data: bytes,
-                            entry: LogEntry) -> None:
+                            entry: LogEntry, off: int = 0) -> None:
         raise NotImplementedError
 
     async def execute_read(self, oid: str, offset: int, length: int) -> bytes:
@@ -182,14 +223,19 @@ class ReplicatedBackend(PGBackend):
     every commit (src/osd/ReplicatedBackend.cc submit_transaction)."""
 
     async def execute_write(self, oid: str, op: str, data: bytes,
-                            entry: LogEntry) -> None:
+                            entry: LogEntry, off: int = 0) -> None:
         pg = self.pg
+        if op == "append":
+            # resolve the append offset at the primary so every replica
+            # splices at the same position regardless of its local state
+            op = "write"
+            off = self.object_size(oid) if self.local_exists(oid) else 0
         peers = {o for o in pg.acting
                  if o not in (CRUSH_NONE, self.host.whoami)}
         tid = self.new_tid()
         fut = self._start_waiting(tid, peers)
         # local first (the primary is always a replica of itself)
-        self.local_apply(oid, op, data)
+        self.local_apply(oid, op, data, off=off)
         msg_payload = {
             "pgid": [pg.pgid.pool, pg.pgid.ps],
             "tid": tid,
@@ -197,6 +243,7 @@ class ReplicatedBackend(PGBackend):
             "from": self.host.whoami,
             "oid": oid,
             "op": op,
+            "off": off,
             "entry": entry.to_dict(),
         }
         for peer in peers:
@@ -219,7 +266,7 @@ class ReplicatedBackend(PGBackend):
     async def handle_rep_op(self, conn, msg: MOSDRepOp) -> None:
         p = msg.payload
         entry = LogEntry.from_dict(p["entry"])
-        self.local_apply(p["oid"], p["op"], msg.data)
+        self.local_apply(p["oid"], p["op"], msg.data, off=p.get("off", 0))
         if entry.version > self.pg.log.head:
             self.pg.log.append(entry)
         # a full-state op supersedes whatever we were missing

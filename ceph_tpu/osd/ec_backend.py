@@ -2,26 +2,34 @@
 caller.
 
 Re-creation of the reference EC write/read pipeline
-(src/osd/ECBackend.cc, src/osd/ECCommon.cc):
-  * writes stripe-encode the object through the pool's EC plugin and fan
-    per-shard sub-writes to the acting set's positions, acking the
-    client only when ALL live shards commit (ECCommon.cc:704 start_rmw,
-    :789 try_reads_to_commit; sub-write apply ECBackend.cc:936);
-  * reads gather any k shards — degraded reads reconstruct missing
-    chunks via the plugin decode (ReadPipeline, ECCommon.cc:597
-    objects_read_and_reconstruct, minimum_to_decode :281);
-  * per-shard chunk crc32c rides an object attr and is verified when a
-    shard is served (HashInfo, src/osd/ECUtil.h:141; verify at read
-    ECBackend.cc:1092-1120);
+(src/osd/ECBackend.cc, src/osd/ECCommon.cc, src/osd/ECTransaction.cc):
+  * writes are PLANNED (ECTransaction::get_write_plan,
+    src/osd/ECTransaction.h:34): the touched logical range is
+    stripe-aligned, missing stripe fragments are read back from shards
+    (the RMW pipeline, ECCommon.cc:704 start_rmw / :715
+    try_state_to_reads), only the affected stripes are re-encoded — in
+    ONE batched device dispatch — and per-shard extent sub-writes fan
+    out to the acting set (ECCommon.cc:890-921); append and ranged
+    overwrite are first-class (ECTransaction.cc:498-535 stripe-aligned
+    zero-padding);
+  * reads fetch ONLY the chunk extents of touched stripes
+    (ECCommon.cc:281 get_min_avail_to_read_shards, :503
+    get_want_to_read_shards); degraded reads reconstruct missing chunks
+    from any k survivors via the plugin decode;
+  * shard integrity rides a per-chunk crc32c list in an object attr,
+    verified shard-side whenever a chunk is served — the analog of the
+    reference's BlueStore Checksummer protection that ec_overwrites
+    pools rely on (src/os/bluestore/Checksummer.h; the append-only
+    HashInfo of src/osd/ECUtil.h:141 survives in ec_util for the tools
+    layer, but a cumulative hash cannot absorb partial overwrites);
   * recovery reconstructs a lost position's chunk from k survivors and
     pushes it (RecoveryOp, ECBackend.h:191).
 
-Idiomatic divergences: whole-object writes (write_full) instead of the
-RMW partial-overwrite pipeline, so no ExtentCache; chunks live in the
-PG's collection with their shard index as an attr instead of
-shard-suffixed collections (one OSD holds at most one shard of a PG);
-encode/decode go through the batched ec_util driver — on a TPU backend
-one device dispatch per stripe batch.
+Idiomatic divergences: chunks live in the PG's collection with their
+shard index as an attr instead of shard-suffixed collections (one OSD
+holds at most one shard of a PG); no ExtentCache — the RMW read leans
+on the batched gather instead; encode/decode go through the batched
+ec_util driver — on a TPU backend one device dispatch per stripe batch.
 """
 from __future__ import annotations
 
@@ -53,8 +61,20 @@ class ECBackend(PGBackend):
         self.n = self.ec_impl.get_chunk_count()
         width = pg.pool.stripe_width or self.k * 4096
         self.sinfo = ec_util.StripeInfo(self.k, width)
+        from ceph_tpu.native import ec_native
+        self._crc32c = ec_native.crc32c
+        # crc of an all-zero chunk: hole stripes materialize as zeros
+        self._zcrc = self._crc32c(b"\x00" * self.sinfo.chunk_size)
         # read gather plumbing: tid -> future resolving to (payload, data)
         self._read_waiters: dict[int, asyncio.Future] = {}
+        # RMW writes read-modify-write whole stripes: concurrent writers
+        # to one object must serialize or interleave into lost updates
+        # (the reference's ObjectContext rw locks). oid -> [lock, users];
+        # refcounted so churn workloads don't grow the dict unboundedly
+        self._obj_locks: dict[str, list] = {}
+        # observability: extent bytes served to sub-reads (tests assert
+        # ranged reads move << object size)
+        self.sub_read_bytes_served = 0
 
     # -- helpers -------------------------------------------------------------
 
@@ -63,22 +83,67 @@ class ECBackend(PGBackend):
         return {i: o for i, o in enumerate(self.pg.acting)
                 if o != CRUSH_NONE and self.host.osdmap.is_up(o)}
 
+
     def _pad(self, data: bytes) -> bytes:
         w = self.sinfo.stripe_width
-        pad = (-len(data)) % w
-        return data + b"\x00" * pad if pad or data else b"\x00" * w
+        return data + b"\x00" * ((-len(data)) % w)
 
-    def _chunk_attrs(self, shard: int, size: int, hinfo: dict,
-                     version) -> dict:
+    def _csums(self, shard_buf: bytes) -> list[int]:
+        """Per-chunk crc32c list of a shard buffer (Checksummer analog)."""
+        c = self.sinfo.chunk_size
+        return [self._crc32c(shard_buf[i:i + c])
+                for i in range(0, len(shard_buf), c)]
+
+    def _chunk_attrs(self, shard: int, size: int, version,
+                     csums: list[int]) -> dict:
         return {"shard": str(shard).encode(),
                 "ec_size": str(size).encode(),
-                "hinfo": json.dumps(hinfo).encode(),
+                "csum": json.dumps(csums).encode(),
                 "version": json.dumps(list(version)).encode()}
 
-    # -- write path (RMWPipeline-lite) ---------------------------------------
+    def _verified_local_extent(
+            self, oid: str, chunk_off: int,
+            chunk_len: int) -> tuple[bytes, int, int, tuple] | None:
+        """Read [chunk_off, chunk_off+chunk_len) of the local shard blob
+        with per-chunk crc verification; None if absent or corrupt."""
+        if not self.local_exists(oid):
+            return None
+        cid, gh = self.coll(), self.ghobject(oid)
+        data = self.host.store.read(cid, gh, chunk_off,
+                                    None if chunk_len < 0 else chunk_len)
+        attrs = self.host.store.getattrs(cid, gh)
+        shard = int(attrs["shard"])
+        csums = json.loads(attrs.get("csum", b"[]"))
+        c = self.sinfo.chunk_size
+        for i in range(0, len(data), c):
+            s = (chunk_off + i) // c
+            have = self._crc32c(data[i:i + c])
+            want = csums[s] if s < len(csums) else None
+            if have != want:
+                dout("osd", 1, f"ec shard {shard} of {oid}: chunk {s} crc "
+                               f"{have:#x} != {want} (EIO)")
+                return None
+        return (data, shard, int(attrs["ec_size"]),
+                tuple(json.loads(attrs.get("version", b"[0, 0]"))))
+
+    # -- write path (RMWPipeline) --------------------------------------------
 
     async def execute_write(self, oid: str, op: str, data: bytes,
-                            entry: LogEntry) -> None:
+                            entry: LogEntry, off: int = 0) -> None:
+        ent = self._obj_locks.get(oid)
+        if ent is None:
+            ent = self._obj_locks[oid] = [asyncio.Lock(), 0]
+        ent[1] += 1
+        try:
+            async with ent[0]:
+                await self._execute_write_locked(oid, op, data, entry, off)
+        finally:
+            ent[1] -= 1
+            if ent[1] == 0 and self._obj_locks.get(oid) is ent:
+                del self._obj_locks[oid]
+
+    async def _execute_write_locked(self, oid: str, op: str, data: bytes,
+                                    entry: LogEntry, off: int) -> None:
         live = self._live_positions()
         if len(live) < self.pg.pool.min_size:
             # the reference blocks the op until min_size is met; our
@@ -86,38 +151,117 @@ class ECBackend(PGBackend):
             raise IntervalChange(
                 f"ec pg {self.pg.pgid}: {len(live)} live shards < "
                 f"min_size {self.pg.pool.min_size}")
-        tid = self.new_tid()
-        peers = {o for o in live.values() if o != self.host.whoami}
-        fut = self._start_waiting(tid, peers)
 
         if op in ("write_full", "push"):
             padded = self._pad(data)
-            shards = ec_util.encode(self.sinfo, self.ec_impl, padded)
-            hinfo = ec_util.HashInfo(self.n)
-            hinfo.append(0, shards)
-            hd = hinfo.to_dict()
-            payloads = {i: (self._chunk_attrs(i, len(data), hd,
-                                              entry.version), shards[i])
-                        for i in live}
+            shards = ec_util.encode(self.sinfo, self.ec_impl, padded) \
+                if padded else {i: b"" for i in range(self.n)}
+            payloads = {
+                i: ({"op": "write_full",
+                     "attrs": self._encode_attrs(self._chunk_attrs(
+                         i, len(data), entry.version,
+                         self._csums(shards[i])))}, shards[i])
+                for i in live}
         elif op in ("delete", "remove"):
-            payloads = {i: (None, b"") for i in live}
+            payloads = {i: ({"op": "delete"}, b"") for i in live}
+        elif op in ("write", "append"):
+            payloads = await self._plan_rmw(oid, op, off, data, entry, live)
+            if payloads is None:        # zero-length no-op past the plan
+                return
         else:
             raise StoreError("EINVAL", f"unknown ec op {op!r}")
+        await self._fan_out(oid, payloads, entry, live)
 
+    @staticmethod
+    def _encode_attrs(attrs: dict) -> dict:
+        return {k: v.decode("latin1") for k, v in attrs.items()}
+
+    async def _plan_rmw(self, oid: str, op: str, off: int, data: bytes,
+                        entry: LogEntry, live: dict) -> dict | None:
+        """get_write_plan + generate_transactions analog
+        (src/osd/ECTransaction.h:34, :97): stripe-align the touched
+        range, read back only the stripe fragments the new data does not
+        fully cover, re-encode the touched stripes in one batched
+        dispatch, and emit per-shard extent sub-writes."""
+        w, c = self.sinfo.stripe_width, self.sinfo.chunk_size
+        cur_size, cur_ver = await self._current_state(oid)
+        if op == "append":
+            off = cur_size
+        if not data:
+            return None                     # zero-length write: no-op
+        new_size = max(cur_size, off + len(data))
+        first = off // w
+        last = -(-(off + len(data)) // w)   # exclusive
+        old_n = -(-cur_size // w)
+        read_upto = min(last, old_n)
+        need_read = any(
+            not (off <= s * w and (s + 1) * w <= off + len(data))
+            for s in range(first, read_upto))
+        existing = b""
+        if need_read:
+            got, _, _ = await self._gather_chunks(
+                oid, chunk_off=first * c,
+                chunk_len=(read_upto - first) * c)
+            existing = ec_util.decode_concat(self.sinfo, self.ec_impl, got)
+        region = bytearray((last - first) * w)
+        region[:len(existing)] = existing
+        start = off - first * w
+        region[start:start + len(data)] = data
+        # bytes past new_size inside the tail stripe are padding: zero
+        # them explicitly in case the read-back carried old padding
+        tail = new_size - first * w
+        if tail < len(region):
+            region[tail:] = b"\x00" * (len(region) - tail)
+
+        shards = ec_util.encode(self.sinfo, self.ec_impl, bytes(region))
+        new_n = -(-new_size // w)
+        payloads = {}
+        for i in live:
+            updates = [[first + s_rel, crc]
+                       for s_rel, crc in enumerate(self._csums(shards[i]))]
+            # hole stripes between the old tail and the write are
+            # materialized as zeros by the store's gap semantics; their
+            # csum entries are the zero-chunk crc
+            updates += [[s, self._zcrc] for s in range(old_n, first)]
+            payloads[i] = ({"op": "extent_write",
+                            "chunk_off": first * c,
+                            "new_size": new_size,
+                            "new_chunks": new_n,
+                            "csum_updates": updates,
+                            "shard": i,
+                            "version": list(entry.version)}, shards[i])
+        return payloads
+
+    async def _current_state(self, oid: str) -> tuple[int, tuple]:
+        """(logical size, version) of the object, 0/(0,0) if absent."""
+        loc = self._verified_local_extent(oid, 0, 0)
+        if loc is not None:
+            return loc[2], loc[3]
+        try:
+            got, size, meta = await self._gather_chunks(
+                oid, chunk_off=0, chunk_len=0)
+            return size, meta["version"]
+        except StoreError as e:
+            if e.code == "ENOENT":
+                return 0, (0, 0)
+            raise
+
+    async def _fan_out(self, oid: str, payloads: dict, entry: LogEntry,
+                       live: dict) -> None:
+        tid = self.new_tid()
+        peers = {o for o in live.values() if o != self.host.whoami}
+        fut = self._start_waiting(tid, peers)
         failed = []
         for idx, osd in live.items():
-            attrs, chunk = payloads[idx]
+            sub, chunk = payloads[idx]
             if osd == self.host.whoami:
-                self._apply_chunk(oid, op, chunk, attrs)
+                self._apply_sub_write(oid, idx, sub, chunk)
                 continue
             try:
                 await self.host.send_osd(osd, MOSDECSubOpWrite(
                     {"pgid": [self.pg.pgid.pool, self.pg.pgid.ps],
                      "tid": tid, "from": self.host.whoami, "oid": oid,
-                     "op": op, "shard": idx,
-                     "attrs": ({k: v.decode("latin1")
-                                for k, v in attrs.items()}
-                               if attrs else None),
+                     "shard": idx, "sub": sub,
                      "entry": entry.to_dict()}, chunk))
             except Exception as e:
                 # an unreachable peer the map hasn't caught up on: the
@@ -137,30 +281,73 @@ class ECBackend(PGBackend):
                 f"retry next interval")
         await asyncio.wait_for(fut, SUBOP_TIMEOUT)
 
-    def _apply_chunk(self, oid: str, op: str, chunk: bytes,
-                     attrs: dict | None) -> None:
-        if op in ("write_full", "push"):
+    def _apply_sub_write(self, oid: str, shard: int, sub: dict,
+                         chunk: bytes) -> None:
+        kind = sub["op"]
+        if kind == "write_full":
+            attrs = {k: v.encode("latin1") for k, v in sub["attrs"].items()}
             self.local_apply(oid, "push", chunk, attrs=attrs)
-        else:
+        elif kind == "extent_write":
+            self._apply_extent(oid, sub, chunk)
+        elif kind == "delete":
             self.local_apply(oid, "delete", b"")
+        else:
+            raise StoreError("EINVAL", f"unknown ec sub-op {kind!r}")
 
-    # -- read path (ReadPipeline-lite) ---------------------------------------
+    def _apply_extent(self, oid: str, sub: dict, chunk: bytes) -> None:
+        """Apply a per-shard extent sub-write: splice the chunk extent
+        into the shard blob (gaps zero-fill via store semantics), merge
+        the per-chunk csum updates, refresh size/version attrs
+        (the per-shard ObjectStore::Transaction of
+        src/osd/ECTransaction.cc:97 generate_transactions)."""
+        from ceph_tpu.objectstore.store import Transaction
+        cid, gh = self.coll(), self.ghobject(oid)
+        store = self.host.store
+        old_csum: list[int] = []
+        if store.exists(cid, gh):
+            try:
+                old_csum = json.loads(store.getattr(cid, gh, "csum"))
+            except StoreError:
+                old_csum = []
+        new_chunks = sub["new_chunks"]
+        csums = [old_csum[s] if s < len(old_csum) else self._zcrc
+                 for s in range(new_chunks)]
+        for s, crc in sub["csum_updates"]:
+            if s < new_chunks:
+                csums[s] = crc
+        txn = Transaction()
+        if not store.exists(cid, gh):
+            txn.touch(cid, gh)
+        if chunk:
+            txn.write(cid, gh, sub["chunk_off"], chunk)
+        c = self.sinfo.chunk_size
+        txn.truncate(cid, gh, new_chunks * c)
+        txn.setattrs(cid, gh, self._chunk_attrs(
+            sub["shard"], sub["new_size"], sub["version"], csums))
+        store.queue_transaction(txn)
+
+    # -- read path (ReadPipeline) --------------------------------------------
 
     async def _gather_chunks(
             self, oid: str,
             exclude_osds: frozenset = frozenset(),
             allow_rollback: bool = False,
+            chunk_off: int = 0,
+            chunk_len: int = -1,
     ) -> tuple[dict[int, bytes], int, dict]:
-        """Collect shard chunks until a version-consistent decodable set
-        exists; returns ({shard: chunk}, logical size, hinfo dict).
+        """Collect shard chunk EXTENTS [chunk_off, chunk_off+chunk_len)
+        until a version-consistent decodable set exists; returns
+        ({shard: extent}, logical size, meta). chunk_len < 0 means to the
+        end of the shard; chunk_len == 0 fetches no data (stat).
 
         Shards carry the eversion of the write that produced them: mixing
         chunks of two writes would decode garbage (the reference guards
-        with HashInfo comparison), so only the newest version holding >= k
-        chunks is used. `exclude_osds` keeps a recovery target's own stale
-        chunk out of its reconstruction. Raises StoreError ENOENT when no
-        shard exists anywhere, EIO when shards exist but no version is
-        decodable (transient: peers down/slow — NOT proof of deletion).
+        with per-shard hashes), so only the newest version holding >= k
+        extents is used. `exclude_osds` keeps a recovery target's own
+        stale chunk out of its reconstruction. Raises StoreError ENOENT
+        when no shard exists anywhere, EIO when shards exist but no
+        version is decodable (transient: peers down/slow — NOT proof of
+        deletion).
 
         If a NEWER version than the best decodable one was observed, the
         default is EIO (serving the older version would roll back a
@@ -170,11 +357,11 @@ class ECBackend(PGBackend):
         (the reference's peering rewinds uncommitted divergent entries
         the same way); meta["rolled_back"] reports it.
         """
-        # per observed version: {shard: (chunk, ec_size, hinfo)}
+        # per observed version: {shard: (extent, ec_size)}
         by_version: dict[tuple, dict[int, tuple]] = {}
 
-        def add(shard: int, data: bytes, size: int, hd: dict, ver) -> None:
-            by_version.setdefault(tuple(ver), {})[shard] = (data, size, hd)
+        def add(shard: int, data: bytes, size: int, ver) -> None:
+            by_version.setdefault(tuple(ver), {})[shard] = (data, size)
 
         def best() -> tuple | None:
             for ver in sorted(by_version, reverse=True):
@@ -182,20 +369,11 @@ class ECBackend(PGBackend):
                     return ver
             return None
 
-        if self.host.whoami not in exclude_osds and self.local_exists(oid):
-            from ceph_tpu.native import ec_native
-            data, attrs = self.read_for_push(oid)
-            shard = int(attrs["shard"])
-            hd = json.loads(attrs["hinfo"])
-            # the coordinator's own chunk gets the same crc gate a remote
-            # sub-read would: local bit-rot must not poison the decode
-            want_crc = ec_util.HashInfo.from_dict(hd).get_chunk_hash(shard)
-            if ec_native.crc32c(data) == want_crc:
-                add(shard, data, int(attrs["ec_size"]), hd,
-                    json.loads(attrs.get("version", b"[0, 0]")))
-            else:
-                dout("osd", 1, f"ec local shard {shard} of {oid}: crc "
-                               f"mismatch, reconstructing around it")
+        if self.host.whoami not in exclude_osds:
+            loc = self._verified_local_extent(oid, chunk_off, chunk_len)
+            if loc is not None:
+                data, shard, size, ver = loc
+                add(shard, data, size, ver)
 
         # two rounds: ask a minimum set first (k shards total, preferring
         # data positions), top up with the remaining positions only when
@@ -221,7 +399,8 @@ class ECBackend(PGBackend):
                 try:
                     await self.host.send_osd(osd, MOSDECSubOpRead(
                         {"pgid": [self.pg.pgid.pool, self.pg.pgid.ps],
-                         "tid": tid, "from": self.host.whoami, "oid": oid}))
+                         "tid": tid, "from": self.host.whoami, "oid": oid,
+                         "chunk_off": chunk_off, "chunk_len": chunk_len}))
                     futs.add(fut)
                 except Exception as e:
                     # unreachable peer: just a missing chunk, not a failed
@@ -268,7 +447,6 @@ class ECBackend(PGBackend):
                     payload, data = fut.result()
                     if payload.get("found"):
                         add(payload["shard"], data, payload["ec_size"],
-                            payload.get("hinfo") or {},
                             payload.get("version", (0, 0)))
         finally:
             for fut, tid in waits.items():
@@ -299,36 +477,49 @@ class ECBackend(PGBackend):
                            f"{newest} ({len(by_version[newest])} shards) "
                            f"back to {ver}")
         shards = by_version[ver]
-        got = {shard: data for shard, (data, _, _) in shards.items()}
+        got = {shard: data for shard, (data, _) in shards.items()}
         any_shard = next(iter(shards.values()))
-        return got, any_shard[1], {"hinfo": any_shard[2], "version": ver,
+        return got, any_shard[1], {"version": ver,
                                    "rolled_back": rolled_back}
 
     async def execute_read(self, oid: str, offset: int,
                            length: int) -> bytes:
-        got, ec_size, _ = await self._gather_chunks(oid)
-        data = ec_util.decode_concat(self.sinfo, self.ec_impl, got)[:ec_size]
+        """Ranged read: fetch only the chunk extents of touched stripes
+        (the reference computes the same bounds via
+        offset_len_to_stripe_bounds, ECCommon.cc:281,503)."""
+        w, c = self.sinfo.stripe_width, self.sinfo.chunk_size
+        first = offset // w
         if length <= 0:
-            return data[offset:]
-        return data[offset:offset + length]
+            chunk_off, chunk_len = first * c, -1
+        else:
+            last = -(-(offset + length) // w)
+            chunk_off, chunk_len = first * c, (last - first) * c
+        got, ec_size, _ = await self._gather_chunks(
+            oid, chunk_off=chunk_off, chunk_len=chunk_len)
+        data = ec_util.decode_concat(self.sinfo, self.ec_impl, got)
+        start = offset - first * w
+        end = (ec_size if length <= 0 else min(offset + length, ec_size)) \
+            - first * w
+        return data[start:max(start, end)]
+
+    async def execute_stat(self, oid: str) -> int:
+        loc = self._verified_local_extent(oid, 0, 0)
+        if loc is not None:
+            return loc[2]
+        _, ec_size, _ = await self._gather_chunks(oid, chunk_off=0,
+                                                  chunk_len=0)
+        return ec_size
 
     async def object_exists(self, oid: str) -> bool:
         if self.local_exists(oid):
             return True
         try:
-            await self._gather_chunks(oid)
+            await self._gather_chunks(oid, chunk_off=0, chunk_len=0)
             return True
         except StoreError as e:
             # EIO = shards exist but are (transiently) undecodable: the
             # object exists; only authoritative absence is False
             return e.code != "ENOENT"
-
-    async def execute_stat(self, oid: str) -> int:
-        if self.local_exists(oid):
-            _, attrs = self.read_for_push(oid)
-            return int(attrs["ec_size"])
-        _, ec_size, _ = await self._gather_chunks(oid)
-        return ec_size
 
     def object_size(self, oid: str) -> int:
         _, attrs = self.read_for_push(oid)
@@ -339,9 +530,7 @@ class ECBackend(PGBackend):
     async def handle_sub_op(self, conn, msg) -> None:
         p = msg.payload
         if isinstance(msg, MOSDECSubOpWrite):
-            attrs = ({k: v.encode("latin1") for k, v in p["attrs"].items()}
-                     if p.get("attrs") else None)
-            self._apply_chunk(p["oid"], p["op"], msg.data, attrs)
+            self._apply_sub_write(p["oid"], p["shard"], p["sub"], msg.data)
             entry = LogEntry.from_dict(p["entry"])
             if entry.version > self.pg.log.head:
                 self.pg.log.append(entry)
@@ -351,32 +540,19 @@ class ECBackend(PGBackend):
                 {"pgid": p["pgid"], "tid": p["tid"],
                  "from": self.host.whoami}))
             return
-        # sub-read: serve our chunk, crc-verified (ECBackend.cc:1092)
-        found = self.local_exists(p["oid"])
+        # sub-read: serve our chunk extent, crc-verified per chunk
+        # (ECBackend.cc:1015 handle_sub_read, crc verify :1092)
         payload = {"pgid": p["pgid"], "tid": p["tid"],
                    "from": self.host.whoami, "oid": p["oid"],
                    "found": False, "shard": -1, "ec_size": -1}
+        loc = self._verified_local_extent(
+            p["oid"], p.get("chunk_off", 0), p.get("chunk_len", -1))
         data = b""
-        if found:
-            from ceph_tpu.native import ec_native
-            data, attrs = self.read_for_push(p["oid"])
-            shard = int(attrs["shard"])
-            hdict = json.loads(attrs["hinfo"])
-            hinfo = ec_util.HashInfo.from_dict(hdict)
-            have = ec_native.crc32c(data)
-            want = hinfo.get_chunk_hash(shard)
-            if have != want:
-                # a corrupt shard must not poison a decode: answer EIO
-                # (not-found) so the reader reconstructs from survivors
-                dout("osd", 1, f"ec shard {shard} of {p['oid']}: crc "
-                               f"mismatch {have:#x} != {want:#x} (EIO)")
-                data = b""
-            else:
-                payload.update({"found": True, "shard": shard,
-                                "ec_size": int(attrs["ec_size"]),
-                                "hinfo": hdict,
-                                "version": json.loads(
-                                    attrs.get("version", b"[0, 0]"))})
+        if loc is not None:
+            data, shard, size, ver = loc
+            payload.update({"found": True, "shard": shard,
+                            "ec_size": size, "version": list(ver)})
+            self.sub_read_bytes_served += len(data)
         conn.send_message(MOSDECSubOpReadReply(payload, data))
 
     def handle_sub_op_reply(self, msg) -> None:
@@ -424,8 +600,8 @@ class ECBackend(PGBackend):
         else:
             chunk = ec_util.decode_shards(self.sinfo, self.ec_impl,
                                           got, [idx])[idx]
-        return chunk, self._chunk_attrs(idx, ec_size, meta["hinfo"],
-                                        meta["version"])
+        return chunk, self._chunk_attrs(idx, ec_size, meta["version"],
+                                        self._csums(chunk))
 
     async def push_object(self, peer: int, oid: str) -> None:
         """Reconstruct `peer`'s positional chunk from k survivors and

@@ -225,6 +225,37 @@ class IoCtx:
             self.pool_name, oid, [{"op": "write_full", "oid": oid}], data)
         return p
 
+    async def write(self, oid: str, data: bytes, offset: int = 0) -> dict:
+        """Ranged write (rados_write): extends the object as needed; on
+        EC pools this drives the RMW partial-stripe pipeline."""
+        p, _ = await self.client.submit(
+            self.pool_name, oid,
+            [{"op": "write", "oid": oid, "off": offset}], data)
+        return p
+
+    async def append(self, oid: str, data: bytes) -> dict:
+        p, _ = await self.client.submit(
+            self.pool_name, oid, [{"op": "append", "oid": oid}], data)
+        return p
+
+    async def create(self, oid: str, exclusive: bool = True) -> dict:
+        p, _ = await self.client.submit(
+            self.pool_name, oid,
+            [{"op": "create", "oid": oid, "exclusive": exclusive}])
+        return p
+
+    async def truncate(self, oid: str, size: int) -> dict:
+        p, _ = await self.client.submit(
+            self.pool_name, oid,
+            [{"op": "truncate", "oid": oid, "size": size}])
+        return p
+
+    async def zero(self, oid: str, offset: int, length: int) -> dict:
+        p, _ = await self.client.submit(
+            self.pool_name, oid,
+            [{"op": "zero", "oid": oid, "off": offset, "len": length}])
+        return p
+
     async def read(self, oid: str, offset: int = 0, length: int = 0) -> bytes:
         _, data = await self.client.submit(
             self.pool_name, oid,
@@ -240,6 +271,51 @@ class IoCtx:
         p, _ = await self.client.submit(
             self.pool_name, oid, [{"op": "stat", "oid": oid}])
         return p["results"][0]["out"]
+
+    # -- xattrs / omap (replicated pools; EC pools return EOPNOTSUPP) ---------
+
+    async def setxattr(self, oid: str, name: str, value: bytes) -> dict:
+        p, _ = await self.client.submit(
+            self.pool_name, oid,
+            [{"op": "setxattr", "oid": oid, "name": name}], value)
+        return p
+
+    async def getxattr(self, oid: str, name: str) -> bytes:
+        _, data = await self.client.submit(
+            self.pool_name, oid,
+            [{"op": "getxattr", "oid": oid, "name": name}])
+        return data
+
+    async def getxattrs(self, oid: str) -> dict[str, bytes]:
+        p, _ = await self.client.submit(
+            self.pool_name, oid, [{"op": "getxattrs", "oid": oid}])
+        return {k: v.encode("latin1")
+                for k, v in p["results"][0]["out"]["xattrs"].items()}
+
+    async def rmxattr(self, oid: str, name: str) -> dict:
+        p, _ = await self.client.submit(
+            self.pool_name, oid,
+            [{"op": "rmxattr", "oid": oid, "name": name}])
+        return p
+
+    async def omap_set(self, oid: str, kv: dict[str, bytes]) -> dict:
+        p, _ = await self.client.submit(
+            self.pool_name, oid,
+            [{"op": "omap_set", "oid": oid,
+              "kv": {k: v.decode("latin1") for k, v in kv.items()}}])
+        return p
+
+    async def omap_get(self, oid: str) -> dict[str, bytes]:
+        p, _ = await self.client.submit(
+            self.pool_name, oid, [{"op": "omap_get", "oid": oid}])
+        return {k: v.encode("latin1")
+                for k, v in p["results"][0]["out"]["omap"].items()}
+
+    async def omap_rm(self, oid: str, keys: list[str]) -> dict:
+        p, _ = await self.client.submit(
+            self.pool_name, oid,
+            [{"op": "omap_rm", "oid": oid, "keys": keys}])
+        return p
 
     async def list_objects(self) -> list[str]:
         """Union of object listings across this pool's PG primaries."""

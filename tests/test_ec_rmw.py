@@ -1,0 +1,289 @@
+"""EC append + partial-overwrite RMW pipeline, ranged EC reads, and the
+expanded client op surface (r4 verdict items #1/#3/#6).
+
+Reference contracts being exercised:
+  * ECTransaction::get_write_plan / generate_transactions
+    (src/osd/ECTransaction.h:34, .cc:97): appends and ranged overwrites
+    stripe-align, read back only uncovered fragments, re-encode touched
+    stripes, emit per-shard extents;
+  * ECCommon read pipeline (src/osd/ECCommon.cc:281,503): ranged reads
+    fetch only the chunk extents of touched stripes;
+  * do_osd_ops surface (src/osd/PrimaryLogPG.cc:5989): create/write/
+    append/truncate/zero/xattr/omap verbs; omap rejected on EC pools.
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from ceph_tpu.rados import ObjectNotFound, RadosError
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+
+
+async def make_ec_cluster(tmp_path, k: int, m: int, n_osds: int,
+                          pg_num: int = 1, plugin: str = "jerasure"):
+    c = ClusterHarness(tmp_path, n_osds=n_osds)
+    await c.start()
+    cl = await c.client()
+    await cl.command({"prefix": "osd erasure-code-profile set",
+                      "name": "prof",
+                      "profile": {"plugin": plugin, "k": str(k),
+                                  "m": str(m)}})
+    await cl.pool_create("ecpool", pg_num=pg_num, pool_type="erasure",
+                         erasure_code_profile="prof")
+    return c, cl, cl.ioctx("ecpool")
+
+
+W = 2 * 4096        # stripe width for k=2 (chunk 4096)
+
+
+@pytest.mark.parametrize("k,m,n_osds", [(2, 1, 3), (2, 2, 4)])
+def test_ec_append_and_ranged_write(tmp_path, k, m, n_osds):
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, k, m, n_osds)
+        try:
+            # append from nothing, in non-stripe-aligned pieces
+            model = bytearray()
+            for i, size in enumerate([100, W, W - 100, 3 * W + 17, 5]):
+                piece = bytes([i + 1]) * size
+                await io.append("a", piece)
+                model += piece
+                assert await io.read("a") == bytes(model)
+                assert (await io.stat("a"))["size"] == len(model)
+
+            # ranged overwrites: interior, cross-stripe, unaligned
+            for off, size, fill in [(10, 50, 0x61), (W - 30, 60, 0x62),
+                                    (W, W, 0x63), (2 * W + 1, 2, 0x64)]:
+                piece = bytes([fill]) * size
+                await io.write("a", piece, offset=off)
+                model[off:off + size] = piece
+                assert await io.read("a") == bytes(model), (off, size)
+
+            # extending overwrite past the end
+            piece = b"\xEE" * (W + 7)
+            off = len(model) - 10
+            await io.write("a", piece, offset=off)
+            model[off:off + len(piece)] = piece
+            assert await io.read("a") == bytes(model)
+
+            # write creating a hole in a fresh object: gap reads zero
+            await io.write("h", b"tail", offset=3 * W + 5)
+            assert await io.read("h") == b"\0" * (3 * W + 5) + b"tail"
+            assert (await io.stat("h"))["size"] == 3 * W + 5 + 4
+
+            # ranged reads at stripes far from the touched ones
+            assert await io.read("a", offset=W + 3, length=10) == \
+                bytes(model[W + 3:W + 13])
+            assert await io.read("a", offset=len(model) - 4, length=100) \
+                == bytes(model[-4:])
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_ec_rmw_degraded_and_recovery(tmp_path):
+    """k=2,m=2 (min_size=3): appends + overwrites keep working with one
+    shard OSD down; after it restarts, peering reconstructs its chunks
+    and a subsequent healthy read round-trips."""
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 2, 4)
+        try:
+            model = bytearray()
+            for i in range(4):
+                piece = bytes([i + 1]) * (W + 13)
+                await io.append("a", piece)
+                model += piece
+            await c.kill_osd(3)
+            await c.wait_osd_down(3)
+            # degraded RMW: overwrite + append with 3 of 4 shards
+            await io.write("a", b"\xAA" * 600, offset=W - 300)
+            model[W - 300:W + 300] = b"\xAA" * 600
+            piece = b"\xBB" * 99
+            await io.append("a", piece)
+            model += piece
+            assert await io.read("a") == bytes(model)
+            # revive: recovery reconstructs the missed extents
+            await c.start_osd(3)
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                osd = c.osds[3]
+                ok = False
+                for pg in osd.pgs.values():
+                    if pg.state in ("active", "replica") and \
+                            "a" in pg.list_objects():
+                        ok = True
+                if ok:
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError("osd.3 never recovered the object")
+                await asyncio.sleep(0.2)
+            assert await io.read("a") == bytes(model)
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_ec_ranged_read_moves_few_bytes(tmp_path):
+    """A small read of a large object must fetch only the touched
+    stripes' chunk extents from peer shards, not whole shard blobs
+    (verdict #6: per-shard bytes transferred << object size)."""
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3)
+        try:
+            size = 64 * W                       # 512 KiB, 64 stripes
+            blob = random.Random(7).randbytes(size)
+            await io.write_full("big", blob)
+
+            def served() -> int:
+                return sum(pg.backend.sub_read_bytes_served
+                           for osd in c.osds.values()
+                           for pg in osd.pgs.values())
+
+            base = served()
+            got = await io.read("big", offset=5 * W + 123, length=4096)
+            assert got == blob[5 * W + 123:5 * W + 123 + 4096]
+            moved = served() - base
+            assert 0 < moved <= 4 * 4096, \
+                f"ranged read moved {moved} bytes of a {size} byte object"
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_ec_rmw_random_model(tmp_path):
+    """Randomized append/write/write_full/read mix against a bytearray
+    model on one EC PG — the write-planning edge cases (holes, tails,
+    stripe corners) that enumerated cases miss."""
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3)
+        try:
+            rng = random.Random(1234)
+            model = bytearray()
+            for step in range(40):
+                roll = rng.random()
+                if roll < 0.35:
+                    piece = rng.randbytes(rng.randrange(1, 3 * W))
+                    await io.append("x", piece)
+                    model += piece
+                elif roll < 0.7:
+                    off = rng.randrange(0, max(1, len(model) + W))
+                    piece = rng.randbytes(rng.randrange(1, 2 * W))
+                    await io.write("x", piece, offset=off)
+                    if off > len(model):
+                        model += b"\0" * (off - len(model))
+                    model[off:off + len(piece)] = piece
+                elif roll < 0.8:
+                    piece = rng.randbytes(rng.randrange(0, 2 * W))
+                    await io.write_full("x", piece)
+                    model = bytearray(piece)
+                else:
+                    if len(model):
+                        off = rng.randrange(0, len(model))
+                        ln = rng.randrange(1, len(model) - off + 1)
+                        assert await io.read("x", offset=off, length=ln) \
+                            == bytes(model[off:off + ln]), f"step {step}"
+                if step % 10 == 9:
+                    assert await io.read("x") == bytes(model), f"step {step}"
+            assert await io.read("x") == bytes(model)
+            assert (await io.stat("x"))["size"] == len(model)
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_replicated_extent_xattr_omap_ops(tmp_path):
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=4, size=3)
+            io = cl.ioctx("rbd")
+            # extent writes with a hole + append + zero + truncate
+            await io.write("o", b"hello", offset=10)
+            assert await io.read("o") == b"\0" * 10 + b"hello"
+            await io.append("o", b"!!")
+            assert await io.read("o") == b"\0" * 10 + b"hello!!"
+            await io.zero("o", 11, 3)
+            assert await io.read("o") == b"\0" * 10 + b"h\0\0\0o!!"
+            await io.truncate("o", 12)
+            assert await io.read("o") == b"\0" * 10 + b"h\0"
+            assert (await io.stat("o"))["size"] == 12
+            await io.truncate("o", 15)      # extend with zeros
+            assert (await io.stat("o"))["size"] == 15
+            # ranged read
+            assert await io.read("o", offset=10, length=1) == b"h"
+
+            # exclusive create
+            await io.create("c1", exclusive=True)
+            with pytest.raises(RadosError) as ei:
+                await io.create("c1", exclusive=True)
+            assert ei.value.rc == -17
+            await io.create("c1", exclusive=False)      # idempotent
+
+            # xattrs
+            await io.setxattr("o", "color", b"blue")
+            await io.setxattr("o", "shape", b"round")
+            assert await io.getxattr("o", "color") == b"blue"
+            assert await io.getxattrs("o") == {"color": b"blue",
+                                               "shape": b"round"}
+            await io.rmxattr("o", "color")
+            assert await io.getxattrs("o") == {"shape": b"round"}
+            with pytest.raises(RadosError) as ei:
+                await io.getxattr("o", "color")
+            assert ei.value.rc == -61
+
+            # omap
+            await io.omap_set("o", {"k1": b"v1", "k2": b"v2"})
+            assert await io.omap_get("o") == {"k1": b"v1", "k2": b"v2"}
+            await io.omap_rm("o", ["k1"])
+            assert await io.omap_get("o") == {"k2": b"v2"}
+
+            # replicas converge on the extent state (all-commit fan-out)
+            data_by_osd = []
+            for osd in c.osds.values():
+                for pg in osd.pgs.values():
+                    if "o" in pg.list_objects():
+                        data_by_osd.append(osd.store.read(
+                            pg.backend.coll(), pg.backend.ghobject("o")))
+            assert len(data_by_osd) == 3
+            assert len(set(data_by_osd)) == 1
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_ec_pool_rejects_unsupported_ops(tmp_path):
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3)
+        try:
+            await io.write_full("o", b"data")
+            for coro in (io.truncate("o", 1), io.zero("o", 0, 1),
+                         io.omap_set("o", {"k": b"v"}),
+                         io.setxattr("o", "a", b"b")):
+                with pytest.raises(RadosError) as ei:
+                    await coro
+                assert ei.value.rc == -95
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_ec_delete_and_recreate_via_rmw(tmp_path):
+    """Delete followed by append re-creates from empty; reads of deleted
+    objects raise ENOENT end-to-end."""
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3)
+        try:
+            await io.append("d", b"abc" * 1000)
+            await io.remove("d")
+            with pytest.raises(ObjectNotFound):
+                await io.read("d")
+            await io.append("d", b"xyz")
+            assert await io.read("d") == b"xyz"
+        finally:
+            await c.stop()
+    run(body())
