@@ -25,6 +25,7 @@ from ceph_tpu.objectstore.store import StoreError, Transaction
 from ceph_tpu.objectstore.types import CollectionId, Ghobject
 from ceph_tpu.osd.pglog import LogEntry
 from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.work_queue import mark_op_event
 
 if TYPE_CHECKING:
     from ceph_tpu.osd.pg import PGInstance
@@ -249,7 +250,9 @@ class ReplicatedBackend(PGBackend):
         for peer in peers:
             await self.host.send_osd(peer, MOSDRepOp(dict(msg_payload),
                                                      data))
+        mark_op_event("sub_ops_sent")
         await asyncio.wait_for(fut, SUBOP_TIMEOUT)
+        mark_op_event("commit")
 
     async def execute_read(self, oid: str, offset: int,
                            length: int) -> bytes:

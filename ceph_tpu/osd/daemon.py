@@ -34,7 +34,8 @@ from ceph_tpu.osd.pg import PGInstance
 from ceph_tpu.utils.admin_socket import AdminSocket
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.throttle import HeartbeatMap
-from ceph_tpu.utils.work_queue import Finisher, OpTracker, ShardedOpQueue
+from ceph_tpu.utils.work_queue import (Finisher, OpTracker, ShardedOpQueue,
+                                       reset_current_op, set_current_op)
 
 
 class OSD(Dispatcher):
@@ -91,6 +92,10 @@ class OSD(Dispatcher):
         self.pgs: dict[PG, PGInstance] = {}
         self.addr: tuple[str, int] | None = None
         self._conns: dict[int, Connection] = {}
+        # ops parked until their PG finishes peering (waiting_for_active,
+        # src/osd/PG.cc): preserves arrival order without wedging a
+        # queue shard on a peering PG
+        self._waiting_for_active: dict[PG, list] = {}
         self._booted = asyncio.Event()
         self._hb_task: asyncio.Task | None = None
         self._reboot_task: asyncio.Task | None = None
@@ -162,6 +167,10 @@ class OSD(Dispatcher):
         for pg in self.pgs.values():
             pg._cancel_peering()
             pg.backend.fail_inflight("osd stopping")
+        for waiting in self._waiting_for_active.values():
+            for _, _, trk in waiting:
+                trk.finish()
+        self._waiting_for_active.clear()
         await self.op_queue.stop()
         await self.finisher.stop()
         if self.asok is not None:
@@ -230,6 +239,24 @@ class OSD(Dispatcher):
                     inst = PGInstance(self, pgid, pool)
                     self.pgs[pgid] = inst
                 inst.advance_map(up, acting)
+        # parked ops whose PG lost primacy (or went straight to active)
+        # must not wait forever
+        for pgid in list(self._waiting_for_active):
+            pg = self.pgs.get(pgid)
+            if pg is not None:
+                if pg.state == "active" or not pg.is_primary():
+                    self.requeue_waiting(pg)
+            else:
+                for conn, msg, trk in self._waiting_for_active.pop(
+                        pgid, []):
+                    trk.finish()
+                    try:
+                        conn.send_message(MOSDOpReply(
+                            {"tid": msg.payload.get("tid", 0), "rc": -11,
+                             "epoch": self.osdmap.epoch,
+                             "error": "pg gone"}))
+                    except Exception:
+                        pass
 
     # -- cluster connections -------------------------------------------------
 
@@ -307,7 +334,7 @@ class OSD(Dispatcher):
                 self._hb_reported.discard(peer)
             return True
         if isinstance(msg, MOSDOp):
-            await self._handle_op(conn, msg)
+            self._ingest_op(conn, msg)
             return True
         if isinstance(msg, MOSDRepOp):
             pg = self._pg_of(msg)
@@ -376,6 +403,76 @@ class OSD(Dispatcher):
             self.pgs[pgid] = inst
             inst.advance_map(up, acting)
         return inst
+
+    # -- op ingest: enqueue_op -> sharded queue -> dequeue_op ---------------
+    # (src/osd/OSD.cc:9683 enqueue_op, :9742 dequeue_op; per-PG hashing
+    # keeps same-PG ops FIFO while shards run concurrently)
+
+    def _ingest_op(self, conn: Connection, msg: MOSDOp) -> None:
+        p = msg.payload
+        pool_id, ps = p["pgid"]
+        pgid = PG(pool_id, ps)
+        pg = self.pgs.get(pgid)
+        if pg is None or not pg.is_primary():
+            conn.send_message(MOSDOpReply(
+                {"tid": p.get("tid", 0), "rc": -11,
+                 "epoch": self.osdmap.epoch, "error": "not primary"}))
+            return
+        ops = p.get("ops", [])
+        desc = (f"osd_op({'+'.join(o.get('op', '?') for o in ops)} "
+                f"{ops[0].get('oid', '') if ops else ''} "
+                f"pg={pgid.pool}.{pgid.ps} tid={p.get('tid', 0)})")
+        trk = self.optracker.create(desc)
+        trk.mark_event("queued")
+        if pg.state != "active" or self._waiting_for_active.get(pgid):
+            # park until activation; order among parked ops is preserved
+            trk.mark_event("waiting_for_active")
+            self._waiting_for_active.setdefault(pgid, []).append(
+                (conn, msg, trk))
+            return
+        self._enqueue_op(pgid, conn, msg, trk)
+
+    def _enqueue_op(self, pgid: PG, conn: Connection, msg: MOSDOp,
+                    trk) -> None:
+        async def work():
+            # the PG may have left 'active' while this op sat in the
+            # queue: re-park instead of wedging the shard worker on a
+            # peering PG (the reference requeues into waiting_for_active)
+            pg = self.pgs.get(pgid)
+            if pg is not None and pg.is_primary() and pg.state != "active":
+                trk.mark_event("waiting_for_active")
+                self._waiting_for_active.setdefault(pgid, []).append(
+                    (conn, msg, trk))
+                return
+            trk.mark_event("dequeued")
+            token = set_current_op(trk)
+            try:
+                await self._handle_op(conn, msg)
+            finally:
+                reset_current_op(token)
+                trk.finish()
+        self.op_queue.enqueue((pgid.pool, pgid.ps), work)
+
+    def requeue_waiting(self, pg: PGInstance) -> None:
+        """PG activation (or loss of primacy) drains its parked ops in
+        arrival order (the reference requeues waiting_for_active)."""
+        waiting = self._waiting_for_active.pop(pg.pgid, None)
+        if not waiting:
+            return
+        for conn, msg, trk in waiting:
+            if pg.is_primary() and pg.state == "active":
+                trk.mark_event("requeued_after_activation")
+                self._enqueue_op(pg.pgid, conn, msg, trk)
+            else:
+                trk.mark_event("dropped_not_primary")
+                trk.finish()
+                try:
+                    conn.send_message(MOSDOpReply(
+                        {"tid": msg.payload.get("tid", 0), "rc": -11,
+                         "epoch": self.osdmap.epoch,
+                         "error": "not primary"}))
+                except Exception:
+                    pass
 
     async def _handle_op(self, conn: Connection, msg: MOSDOp) -> None:
         p = msg.payload

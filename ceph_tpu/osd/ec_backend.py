@@ -45,6 +45,7 @@ from ceph_tpu.osd import ec_util
 from ceph_tpu.osd.backend import (SUBOP_TIMEOUT, IntervalChange, PGBackend)
 from ceph_tpu.osd.pglog import LogEntry
 from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.work_queue import mark_op_event
 
 READ_TIMEOUT = 5.0
 
@@ -217,12 +218,11 @@ class ECBackend(PGBackend):
         new_n = -(-new_size // w)
         payloads = {}
         for i in live:
+            # hole stripes between the old tail and the write need no
+            # updates: _apply_extent fills missing csum slots with the
+            # zero-chunk crc, matching the store's gap zero-fill
             updates = [[first + s_rel, crc]
                        for s_rel, crc in enumerate(self._csums(shards[i]))]
-            # hole stripes between the old tail and the write are
-            # materialized as zeros by the store's gap semantics; their
-            # csum entries are the zero-chunk crc
-            updates += [[s, self._zcrc] for s in range(old_n, first)]
             payloads[i] = ({"op": "extent_write",
                             "chunk_off": first * c,
                             "new_size": new_size,
@@ -279,7 +279,9 @@ class ECBackend(PGBackend):
             raise IntervalChange(
                 f"ec sub-writes to osds {failed} failed; "
                 f"retry next interval")
+        mark_op_event("sub_ops_sent")
         await asyncio.wait_for(fut, SUBOP_TIMEOUT)
+        mark_op_event("commit")
 
     def _apply_sub_write(self, oid: str, shard: int, sub: dict,
                          chunk: bytes) -> None:

@@ -34,6 +34,7 @@ from ceph_tpu.objectstore.store import StoreError, Transaction
 from ceph_tpu.objectstore.types import CollectionId, Ghobject
 from ceph_tpu.osd.pglog import ZERO, Eversion, LogEntry, PGLog
 from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.work_queue import mark_op_event
 
 if TYPE_CHECKING:
     from ceph_tpu.osd.daemon import OSD
@@ -276,6 +277,7 @@ class PGInstance:
         self.persist_meta()
         self.state = "active"
         self._active_event.set()
+        self.host.requeue_waiting(self)
         dout("osd", 3, f"osd.{self.host.whoami} pg {self.pgid} active "
                        f"(acting {self.acting}, head {self.log.head})")
 
@@ -427,7 +429,14 @@ class PGInstance:
     async def do_op(self, op: dict, data: bytes) -> tuple[int, dict, bytes]:
         """Execute one client op; returns (rc, out, outdata) — the
         do_osd_ops dispatch table (src/osd/PrimaryLogPG.cc:5989)."""
-        await self.wait_active()
+        if not self._active_event.is_set():
+            # never BLOCK a queue shard on a peering PG: the daemon parks
+            # ops at ingest and re-parks at dequeue; an op that still
+            # races an interval flip bounces to the client, which
+            # refreshes the map and resends (landing parked)
+            from ceph_tpu.osd.backend import IntervalChange
+            raise IntervalChange(f"pg {self.pgid} not active ({self.state})")
+        mark_op_event("started")
         oid = op["oid"]
         kind = op["op"]
         if self.pool.type == "erasure" and kind in self.EC_UNSUPPORTED:

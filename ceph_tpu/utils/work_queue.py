@@ -23,11 +23,33 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import contextvars
 import time
 from typing import Awaitable, Callable
 
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.throttle import HeartbeatMap
+
+# the op being processed by the current task — backends stamp events on
+# it without threading a handle through every call (the reference passes
+# OpRequestRef the same way a thread-local trace context would)
+_current_op: contextvars.ContextVar["TrackedOp | None"] = \
+    contextvars.ContextVar("tracked_op", default=None)
+
+
+def set_current_op(op: "TrackedOp | None"):
+    return _current_op.set(op)
+
+
+def reset_current_op(token) -> None:
+    _current_op.reset(token)
+
+
+def mark_op_event(event: str) -> None:
+    """Stamp `event` on the current task's TrackedOp, if any."""
+    op = _current_op.get()
+    if op is not None and not op.done:
+        op.mark_event(event)
 
 
 class TrackedOp:
