@@ -126,3 +126,9 @@ MOSDECSubOpWrite = _simple(0x70, "MOSDECSubOpWrite")
 MOSDECSubOpWriteReply = _simple(0x71, "MOSDECSubOpWriteReply")
 MOSDECSubOpRead = _simple(0x72, "MOSDECSubOpRead")
 MOSDECSubOpReadReply = _simple(0x73, "MOSDECSubOpReadReply")
+
+# -- scrub (MOSDRepScrub / replica scrub map, src/messages/MOSDRepScrub.h) ---
+MOSDRepScrub = _simple(0x80, "MOSDRepScrub")        # {"pgid", "tid", "from",
+                                                    #  "deep": bool}
+MOSDRepScrubMap = _simple(0x81, "MOSDRepScrubMap")  # {"pgid", "tid", "from",
+                                                    #  "map": {oid: entry}}

@@ -102,7 +102,8 @@ class PGBackend:
 
     def local_apply(self, oid: str, op: str, data: bytes,
                     attrs: dict[str, bytes] | None = None,
-                    shard: int = -1, off: int = 0) -> None:
+                    shard: int = -1, off: int = 0,
+                    omap: dict[str, bytes] | None = None) -> None:
         cid = self.coll(shard)
         gh = self.ghobject(oid, shard)
         txn = Transaction()
@@ -113,6 +114,9 @@ class PGBackend:
             txn.write(cid, gh, 0, data)
             if attrs:
                 txn.setattrs(cid, gh, attrs)
+            if omap:
+                # full-state pushes replace omap atomically with the data
+                txn.omap_setkeys(cid, gh, omap)
         elif op == "write":
             if not self.host.store.exists(cid, gh):
                 txn.touch(cid, gh)
@@ -197,12 +201,18 @@ class PGBackend:
         return (self.host.store.read(cid, gh),
                 self.host.store.getattrs(cid, gh))
 
+    def omap_for_push(self, oid: str, shard: int = -1) -> dict[str, bytes]:
+        return self.host.store.omap_get(self.coll(shard),
+                                        self.ghobject(oid, shard))
+
     def apply_push(self, oid: str, data: bytes, attrs: dict,
-                   delete: bool, shard: int = -1) -> None:
+                   delete: bool, shard: int = -1,
+                   omap: dict[str, bytes] | None = None) -> None:
         if delete:
             self.local_apply(oid, "delete", b"", shard=shard)
         else:
-            self.local_apply(oid, "push", data, attrs=attrs, shard=shard)
+            self.local_apply(oid, "push", data, attrs=attrs, shard=shard,
+                             omap=omap)
 
     async def push_object(self, peer: int, oid: str) -> None:
         """Push this object's local state (or its absence) to `peer`.
@@ -210,7 +220,8 @@ class PGBackend:
         positional chunk instead."""
         if self.local_exists(oid):
             data, attrs = self.read_for_push(oid)
-            await self.pg.send_push(peer, oid, data, attrs, delete=False)
+            await self.pg.send_push(peer, oid, data, attrs, delete=False,
+                                    omap=self.omap_for_push(oid))
         else:
             await self.pg.send_push(peer, oid, b"", None, delete=True)
 

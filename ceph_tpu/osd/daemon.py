@@ -24,6 +24,7 @@ from ceph_tpu.crush.osdmap import PG, Incremental, OSDMap
 from ceph_tpu.msg.messages import (Message, MOSDOp, MOSDOpReply, MOSDPGInfo,
                                    MOSDPGLog, MOSDPGPush, MOSDPGPushReply,
                                    MOSDPGQuery, MOSDRepOp, MOSDRepOpReply,
+                                   MOSDRepScrub, MOSDRepScrubMap,
                                    MPing, MPingReply)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
 from ceph_tpu.mon.mon_client import MonClient
@@ -45,6 +46,9 @@ class OSD(Dispatcher):
     HB_GRACE = 3.0              # osd_heartbeat_grace analog
 
     NUM_OP_SHARDS = 5           # osd_op_num_shards analog
+
+    SCRUB_INTERVAL = 60.0       # osd_scrub_min_interval analog
+    DEEP_SCRUB_EVERY = 4        # every Nth scrub round goes deep
 
     def __init__(self, whoami: int, mon_addrs: list[tuple[str, int]],
                  store=None, crush_location: dict | None = None,
@@ -77,6 +81,16 @@ class OSD(Dispatcher):
                 lambda req: self.optracker.dump_historic_slow_ops(),
                 "recently completed slow ops")
             self.asok.register_command(
+                "scrub",
+                lambda req: self._trigger_scrub(req.get("deep", False)),
+                "scrub all primary PGs now (deep=true for deep scrub)")
+            self.asok.register_command(
+                "last_scrub",
+                lambda req: {f"{pgid.pool}.{pgid.ps}": pg.last_scrub
+                             for pgid, pg in self.pgs.items()
+                             if pg.last_scrub is not None},
+                "last scrub result per PG")
+            self.asok.register_command(
                 "status", lambda req: {
                     "whoami": self.whoami,
                     "osdmap_epoch": self.osdmap.epoch,
@@ -98,6 +112,8 @@ class OSD(Dispatcher):
         self._waiting_for_active: dict[PG, list] = {}
         self._booted = asyncio.Event()
         self._hb_task: asyncio.Task | None = None
+        self._scrub_task: asyncio.Task | None = None
+        self._bg_tasks: set[asyncio.Task] = set()
         self._reboot_task: asyncio.Task | None = None
         self._hb_last: dict[int, float] = {}      # peer -> last reply stamp
         self._hb_reported: set[int] = set()
@@ -137,8 +153,48 @@ class OSD(Dispatcher):
                                           crush_location=self.crush_location)
         self._hb_task = asyncio.get_running_loop().create_task(
             self._heartbeat())
+        self._scrub_task = asyncio.get_running_loop().create_task(
+            self._scrub_loop())
         dout("osd", 1, f"osd.{self.whoami} up at {self.addr}")
         return self.addr
+
+    def _trigger_scrub(self, deep: bool) -> dict:
+        n = 0
+        for pg in list(self.pgs.values()):
+            if pg.is_primary() and pg.state == "active":
+                task = asyncio.get_running_loop().create_task(
+                    pg.scrub(deep=deep))
+                # hold a strong ref (the loop keeps only a weak one) and
+                # surface repair failures in the log
+                self._bg_tasks.add(task)
+                task.add_done_callback(self._bg_task_done)
+                n += 1
+        return {"scheduled": n, "deep": deep}
+
+    def _bg_task_done(self, task: asyncio.Task) -> None:
+        self._bg_tasks.discard(task)
+        if not task.cancelled() and task.exception() is not None:
+            e = task.exception()
+            dout("osd", 1, f"osd.{self.whoami} background task failed: "
+                           f"{type(e).__name__} {e}")
+
+    async def _scrub_loop(self) -> None:
+        """Background scrub scheduler: every SCRUB_INTERVAL, scrub each
+        PG this OSD is primary of (the reference's OSD::sched_scrub);
+        every DEEP_SCRUB_EVERY-th round re-reads data (deep)."""
+        rounds = 0
+        while True:
+            await asyncio.sleep(self.SCRUB_INTERVAL)
+            rounds += 1
+            deep = rounds % self.DEEP_SCRUB_EVERY == 0
+            for pg in list(self.pgs.values()):
+                if not (pg.is_primary() and pg.state == "active"):
+                    continue
+                try:
+                    await pg.scrub(deep=deep)
+                except Exception as e:
+                    dout("scrub", 1, f"pg {pg.pgid} scrub failed: "
+                                     f"{type(e).__name__} {e}")
 
     async def _reboot_until_up(self) -> None:
         """Resend MOSDBoot until the map shows us up again (mirrors the
@@ -157,7 +213,7 @@ class OSD(Dispatcher):
 
     async def stop(self) -> None:
         self._stopping = True
-        for task in (self._hb_task, self._reboot_task):
+        for task in (self._hb_task, self._scrub_task, self._reboot_task):
             if task is not None:
                 task.cancel()
                 try:
@@ -368,6 +424,16 @@ class OSD(Dispatcher):
             pg = self._pg_of(msg, create=True)
             if pg is not None and msg.payload.get("op") == "activate":
                 pg.handle_activate(msg)
+            return True
+        if isinstance(msg, MOSDRepScrub):
+            pg = self._pg_of(msg)
+            if pg is not None:
+                await pg.handle_scrub_request(conn, msg)
+            return True
+        if isinstance(msg, MOSDRepScrubMap):
+            pg = self._pg_of(msg)
+            if pg is not None:
+                pg.handle_scrub_map(msg)
             return True
         return await self._dispatch_backend(conn, msg)
 

@@ -110,9 +110,15 @@ class ECBackend(PGBackend):
         if not self.local_exists(oid):
             return None
         cid, gh = self.coll(), self.ghobject(oid)
-        data = self.host.store.read(cid, gh, chunk_off,
-                                    None if chunk_len < 0 else chunk_len)
-        attrs = self.host.store.getattrs(cid, gh)
+        try:
+            data = self.host.store.read(cid, gh, chunk_off,
+                                        None if chunk_len < 0 else chunk_len)
+            attrs = self.host.store.getattrs(cid, gh)
+        except StoreError as e:
+            # a FileStore blob whose crc gate refuses the read: treat as
+            # a missing local chunk and reconstruct around it
+            dout("osd", 1, f"ec local shard of {oid} unreadable: {e}")
+            return None
         shard = int(attrs["shard"])
         csums = json.loads(attrs.get("csum", b"[]"))
         c = self.sinfo.chunk_size

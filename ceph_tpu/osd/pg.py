@@ -29,7 +29,8 @@ from typing import TYPE_CHECKING
 from ceph_tpu.crush.crush import CRUSH_NONE
 from ceph_tpu.crush.osdmap import PG
 from ceph_tpu.msg.messages import (Message, MOSDPGInfo, MOSDPGLog,
-                                   MOSDPGPush, MOSDPGPushReply, MOSDPGQuery)
+                                   MOSDPGPush, MOSDPGPushReply, MOSDPGQuery,
+                                   MOSDRepScrubMap)
 from ceph_tpu.objectstore.store import StoreError, Transaction
 from ceph_tpu.objectstore.types import CollectionId, Ghobject
 from ceph_tpu.osd.pglog import ZERO, Eversion, LogEntry, PGLog
@@ -66,6 +67,18 @@ class PGInstance:
         self._peer_logs: dict[int, dict] = {}
         self._peer_waiters: dict[int, asyncio.Future] = {}
         self._push_waiters: dict[str, asyncio.Future] = {}
+        # scrub: (tid, peer) -> future resolving to the peer's scrub map
+        self._scrub_waiters: dict[tuple, asyncio.Future] = {}
+        self.last_scrub: dict | None = None
+        self._scrub_lock = asyncio.Lock()
+        # write gate: scrub blocks new modifies and drains in-flight ones
+        # so repairs never race an acknowledged write (the reference's
+        # scrub-range write blocking)
+        self._write_gate = asyncio.Event()
+        self._write_gate.set()
+        self._active_writes = 0
+        self._writes_drained = asyncio.Event()
+        self._writes_drained.set()
         if pool.type == "erasure":
             from ceph_tpu.osd.ec_backend import ECBackend
             self.backend = ECBackend(self)
@@ -333,12 +346,16 @@ class PGInstance:
             self._push_waiters.pop(key, None)
 
     async def send_push(self, peer: int, oid: str, data: bytes,
-                        attrs: dict | None, delete: bool) -> None:
+                        attrs: dict | None, delete: bool,
+                        omap: dict | None = None) -> None:
         payload = {"pgid": [self.pgid.pool, self.pgid.ps], "op": "push",
                    "from": self.host.whoami, "oid": oid, "delete": delete}
         if attrs:
             payload["attrs"] = {k: v.decode("latin1")
                                 for k, v in attrs.items()}
+        if omap is not None:
+            payload["omap"] = {k: v.decode("latin1")
+                               for k, v in omap.items()}
         await self.host.send_osd(peer, MOSDPGPush(payload, data))
 
     # -- peering message handlers (both roles) -------------------------------
@@ -367,11 +384,14 @@ class PGInstance:
             oid = p["oid"]
             if self.backend.local_exists(oid):
                 data, attrs = self.backend.read_for_push(oid)
+                omap = self.backend.omap_for_push(oid)
                 conn.send_message(MOSDPGPush(
                     {"pgid": p["pgid"], "op": "push",
                      "from": self.host.whoami, "oid": oid, "delete": False,
                      "attrs": {k: v.decode("latin1")
                                for k, v in attrs.items()},
+                     "omap": {k: v.decode("latin1")
+                              for k, v in omap.items()},
                      "reply_to": "pull"}, data))
             else:
                 conn.send_message(MOSDPGPush(
@@ -382,7 +402,10 @@ class PGInstance:
         # incoming object state
         attrs = {k: v.encode("latin1")
                  for k, v in p.get("attrs", {}).items()}
-        self.backend.apply_push(p["oid"], msg.data, attrs, p["delete"])
+        omap = ({k: v.encode("latin1") for k, v in p["omap"].items()}
+                if "omap" in p else None)
+        self.backend.apply_push(p["oid"], msg.data, attrs, p["delete"],
+                                omap=omap)
         self.log.mark_recovered(p["oid"])
         if p.get("reply_to") == "pull":
             fut = self._push_waiters.get(f"pull:{p['oid']}")
@@ -392,6 +415,40 @@ class PGInstance:
             conn.send_message(MOSDPGPushReply(
                 {"pgid": p["pgid"], "oid": p["oid"],
                  "from": self.host.whoami}))
+
+    # -- scrub ---------------------------------------------------------------
+
+    async def block_writes(self, timeout: float = 10.0) -> None:
+        self._write_gate.clear()
+        if self._active_writes:
+            self._writes_drained.clear()
+            try:
+                await asyncio.wait_for(self._writes_drained.wait(), timeout)
+            except asyncio.TimeoutError:
+                dout("scrub", 1, f"pg {self.pgid}: {self._active_writes} "
+                                 f"writes still in flight after drain "
+                                 f"timeout; scrubbing anyway")
+
+    def unblock_writes(self) -> None:
+        self._write_gate.set()
+
+    async def scrub(self, deep: bool = False) -> dict:
+        """Primary-driven scrub of this PG (scrub_pg in osd/scrub.py)."""
+        from ceph_tpu.osd.scrub import scrub_pg
+        return await scrub_pg(self, deep)
+
+    async def handle_scrub_request(self, conn, msg) -> None:
+        from ceph_tpu.osd.scrub import build_scrub_map
+        p = msg.payload
+        conn.send_message(MOSDRepScrubMap(
+            {"pgid": p["pgid"], "tid": p["tid"], "from": self.host.whoami,
+             "map": await build_scrub_map(self, p.get("deep", False))}))
+
+    def handle_scrub_map(self, msg) -> None:
+        p = msg.payload
+        fut = self._scrub_waiters.get((p["tid"], p["from"]))
+        if fut is not None and not fut.done():
+            fut.set_result(p["map"])
 
     def handle_activate(self, msg: MOSDPGInfo) -> None:
         """Primary says: adopt this log, you are consistent now."""
@@ -504,6 +561,17 @@ class PGInstance:
 
     async def _do_modify(self, kind: str, oid: str, op: dict,
                          data: bytes) -> tuple[int, dict, bytes]:
+        await asyncio.wait_for(self._write_gate.wait(), 30.0)
+        self._active_writes += 1
+        try:
+            return await self._do_modify_inner(kind, oid, op, data)
+        finally:
+            self._active_writes -= 1
+            if self._active_writes == 0:
+                self._writes_drained.set()
+
+    async def _do_modify_inner(self, kind: str, oid: str, op: dict,
+                               data: bytes) -> tuple[int, dict, bytes]:
         if kind == "create":
             exists = await self.backend.object_exists(oid)
             if exists:

@@ -1,0 +1,295 @@
+"""Scrub: background detection + repair of bit-rot, missing copies, and
+digest mismatches, with NO client read involved (r4 verdict item: a
+bit-rotted shard was only found if a read touched it).
+
+Reference contracts: scrub_backend.h:101 per-shard map compare,
+ECBackend.cc:1092-1120 deep shard crc verify, be_select_auth_object
+majority repair."""
+from __future__ import annotations
+
+import asyncio
+import os
+
+from ceph_tpu.objectstore.store import Transaction
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+from tests.test_ec_rmw import make_ec_cluster
+
+
+def _find_holder(c, oid, exclude=()):
+    """(osd, pg, cid, gh) of some OSD holding `oid` locally."""
+    for i, osd in c.osds.items():
+        if i in exclude:
+            continue
+        for pg in osd.pgs.values():
+            if oid in pg.list_objects():
+                return osd, pg
+    raise AssertionError(f"no holder of {oid}")
+
+
+def _corrupt_in_store(osd, pg, oid, flip_at=10):
+    """Flip a byte via a raw store write: store-level checksums stay
+    consistent, so only the EC per-chunk csum / replicated digest can
+    catch it — exactly the scrub layer under test."""
+    cid, gh = pg.backend.coll(), pg.backend.ghobject(oid)
+    blob = bytearray(osd.store.read(cid, gh))
+    blob[flip_at] ^= 0xFF
+    osd.store.queue_transaction(
+        Transaction().write(cid, gh, 0, bytes(blob)))
+
+
+def test_deep_scrub_repairs_ec_shard_bitrot(tmp_path):
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3)
+        try:
+            payload = os.urandom(3 * 8192 + 100)
+            await io.write_full("obj", payload)
+            # corrupt a NON-primary shard in place (csum attr untouched)
+            prim_pg = None
+            for osd in c.osds.values():
+                for pg in osd.pgs.values():
+                    if pg.is_primary() and "obj" in pg.list_objects():
+                        prim_pg = pg
+            assert prim_pg is not None
+            victim, vpg = _find_holder(c, "obj",
+                                       exclude=(prim_pg.host.whoami,))
+            _corrupt_in_store(victim, vpg, "obj")
+            # light scrub does NOT re-read data: no error found
+            res = await prim_pg.scrub(deep=False)
+            assert res["errors"] == 0
+            # deep scrub finds and repairs it without any client read
+            res = await prim_pg.scrub(deep=True)
+            assert res["errors"] == 1 and res["repaired"] == 1
+            assert res["inconsistent"] == ["obj"]
+            # the shard is byte-identical to a fresh reconstruction:
+            # re-scrub comes back clean
+            res = await prim_pg.scrub(deep=True)
+            assert res["errors"] == 0, res
+            assert await io.read("obj") == payload
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_deep_scrub_repairs_primary_own_shard(tmp_path):
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3)
+        try:
+            payload = os.urandom(2 * 8192)
+            await io.write_full("obj", payload)
+            prim_pg = None
+            for osd in c.osds.values():
+                for pg in osd.pgs.values():
+                    if pg.is_primary() and "obj" in pg.list_objects():
+                        prim_pg = pg
+            assert prim_pg is not None
+            _corrupt_in_store(prim_pg.host, prim_pg, "obj")
+            res = await prim_pg.scrub(deep=True)
+            assert res["errors"] == 1 and res["repaired"] == 1
+            res = await prim_pg.scrub(deep=True)
+            assert res["errors"] == 0
+            assert await io.read("obj") == payload
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_deep_scrub_repairs_ec_bitrot_on_disk_filestore(tmp_path):
+    """Bits flipped in the blob FILE on disk (below the store): the
+    FileStore read-time crc refuses the read, scrub marks the shard
+    corrupt and reconstructs it from survivors."""
+    from ceph_tpu.objectstore import FileStore
+
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=3,
+                           store_factory=lambda i: FileStore(
+                               str(tmp_path / f"osd{i}")))
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.command({"prefix": "osd erasure-code-profile set",
+                              "name": "prof",
+                              "profile": {"plugin": "jerasure", "k": "2",
+                                          "m": "1"}})
+            await cl.pool_create("ecpool", pg_num=1, pool_type="erasure",
+                                 erasure_code_profile="prof")
+            io = cl.ioctx("ecpool")
+            payload = os.urandom(4 * 8192)
+            await io.write_full("obj", payload)
+            prim_pg = None
+            for osd in c.osds.values():
+                for pg in osd.pgs.values():
+                    if pg.is_primary() and "obj" in pg.list_objects():
+                        prim_pg = pg
+            victim, vpg = _find_holder(c, "obj",
+                                       exclude=(prim_pg.host.whoami,))
+            cid, gh = vpg.backend.coll(), vpg.backend.ghobject("obj")
+            blob_name = victim.store._colls[cid][gh].blob
+            path = os.path.join(victim.store.blob_dir, blob_name)
+            raw = bytearray(open(path, "rb").read())
+            raw[5] ^= 0xFF
+            with open(path, "wb") as f:
+                f.write(raw)
+            res = await prim_pg.scrub(deep=True)
+            assert res["errors"] == 1 and res["repaired"] == 1, res
+            res = await prim_pg.scrub(deep=True)
+            assert res["errors"] == 0, res
+            assert await io.read("obj") == payload
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_scrub_repairs_replicated_bitrot_and_missing(tmp_path):
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=1, size=3)
+            io = cl.ioctx("rbd")
+            await io.write_full("a", b"payload-a" * 100)
+            await io.omap_set("a", {"k": b"v"})
+            await io.write_full("b", b"payload-b" * 100)
+            prim_pg = None
+            for osd in c.osds.values():
+                for pg in osd.pgs.values():
+                    if pg.is_primary():
+                        prim_pg = pg
+            # one replica's copy of "a" bit-rots; its copy of "b" vanishes
+            victim, vpg = _find_holder(c, "a",
+                                       exclude=(prim_pg.host.whoami,))
+            _corrupt_in_store(victim, vpg, "a")
+            cid, gh = vpg.backend.coll(), vpg.backend.ghobject("b")
+            victim.store.queue_transaction(Transaction().remove(cid, gh))
+            # light scrub already catches the MISSING copy (size map)
+            res = await prim_pg.scrub(deep=False)
+            assert res["errors"] == 1 and "b" in res["inconsistent"]
+            # deep scrub catches the digest mismatch too
+            res = await prim_pg.scrub(deep=True)
+            assert res["errors"] >= 1 and "a" in res["inconsistent"]
+            res = await prim_pg.scrub(deep=True)
+            assert res["errors"] == 0, res
+            # every replica byte-identical again (incl. omap)
+            copies = [osd.store.read(pg.backend.coll(),
+                                     pg.backend.ghobject("a"))
+                      for osd in c.osds.values()
+                      for pg in osd.pgs.values()
+                      if "a" in pg.list_objects()]
+            assert len(copies) == 3 and len(set(copies)) == 1
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_background_scrub_scheduler_repairs(tmp_path, monkeypatch):
+    """The periodic scrub loop (no manual trigger) finds and repairs
+    corruption on its own."""
+    from ceph_tpu.osd.daemon import OSD
+    monkeypatch.setattr(OSD, "SCRUB_INTERVAL", 0.4)
+    monkeypatch.setattr(OSD, "DEEP_SCRUB_EVERY", 1)   # every round deep
+
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3)
+        try:
+            payload = os.urandom(2 * 8192)
+            await io.write_full("obj", payload)
+            prim_pg = None
+            for osd in c.osds.values():
+                for pg in osd.pgs.values():
+                    if pg.is_primary() and "obj" in pg.list_objects():
+                        prim_pg = pg
+            victim, vpg = _find_holder(c, "obj",
+                                       exclude=(prim_pg.host.whoami,))
+            _corrupt_in_store(victim, vpg, "obj")
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                res = prim_pg.last_scrub
+                if res and res.get("deep") and res["repaired"] >= 1:
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError(
+                        f"background scrub never repaired: {res}")
+                await asyncio.sleep(0.2)
+            assert await io.read("obj") == payload
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_scrub_finishes_majority_delete(tmp_path):
+    """An object deleted on the majority but lingering on one replica is
+    DELETED by scrub, not resurrected (absence votes in the
+    authoritative-selection tally)."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=1, size=3)
+            io = cl.ioctx("rbd")
+            await io.write_full("ghost", b"boo")
+            await io.remove("ghost")
+            # resurrect a stale copy on ONE replica behind the cluster's
+            # back (simulates a replica that missed the delete)
+            prim_pg = None
+            for osd in c.osds.values():
+                for pg in osd.pgs.values():
+                    if pg.is_primary():
+                        prim_pg = pg
+            victim = next(o for i, o in c.osds.items()
+                          if i != prim_pg.host.whoami)
+            vpg = next(iter(victim.pgs.values()))
+            cid, gh = vpg.backend.coll(), vpg.backend.ghobject("ghost")
+            victim.store.queue_transaction(
+                Transaction().touch(cid, gh).write(cid, gh, 0, b"stale"))
+            res = await prim_pg.scrub(deep=False)
+            assert res["errors"] == 1 and res["repaired"] == 1, res
+            deadline = asyncio.get_running_loop().time() + 5
+            while "ghost" in vpg.list_objects():
+                assert asyncio.get_running_loop().time() < deadline, \
+                    "stale copy never deleted"
+                await asyncio.sleep(0.05)
+            res = await prim_pg.scrub(deep=False)
+            assert res["errors"] == 0, res
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_scrub_never_guesses_without_majority(tmp_path):
+    """size=2 pool, two VALID but diverged copies: scrub reports the
+    inconsistency and repairs NOTHING (guessing could propagate rot —
+    the reference leaves ambiguous objects to operator repair policy)."""
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=2)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=1, size=2)
+            io = cl.ioctx("rbd")
+            await io.write_full("amb", b"original")
+            prim_pg = None
+            for osd in c.osds.values():
+                for pg in osd.pgs.values():
+                    if pg.is_primary():
+                        prim_pg = pg
+            # silently diverge the PRIMARY's copy (same size, valid store
+            # crc): the old majority-with-primary-tiebreak would have
+            # pushed the rot over the good replica
+            _corrupt_in_store(prim_pg.host, prim_pg, "amb", flip_at=2)
+            before = {i: osd.store.read(
+                next(iter(osd.pgs.values())).backend.coll(),
+                next(iter(osd.pgs.values())).backend.ghobject("amb"))
+                for i, osd in c.osds.items()}
+            res = await prim_pg.scrub(deep=True)
+            assert res["errors"] >= 1 and res["repaired"] == 0, res
+            assert "amb" in res["unrepaired"], res
+            after = {i: osd.store.read(
+                next(iter(osd.pgs.values())).backend.coll(),
+                next(iter(osd.pgs.values())).backend.ghobject("amb"))
+                for i, osd in c.osds.items()}
+            assert before == after      # nothing was overwritten
+        finally:
+            await c.stop()
+    run(body())
