@@ -10,9 +10,11 @@ bitplane-matmul codec (ceph_tpu.ops.rs_codec), so the same code runs the
 w=8 field math on CPU or TPU (construction-compatible with jerasure;
 independently cross-validated in tests/test_gf256_independent.py).
 
-Supported techniques: reed_sol_van, reed_sol_r6_op, cauchy_orig, cauchy_good.
-The minimal-density bitmatrix RAID-6 family (liberation, blaum_roth,
-liber8tion) is intentionally deferred; profiles naming them raise cleanly.
+Supported techniques: reed_sol_van, reed_sol_r6_op, cauchy_orig,
+cauchy_good (GF(2^8) matrix codes on the bitplane-matmul codec), and the
+minimal-density bitmatrix RAID-6 family — liberation, blaum_roth,
+liber8tion — lowered onto the GF(2) packet-XOR machinery in
+ceph_tpu.ec.bitmatrix (constructions verified MDS at prepare()).
 """
 from __future__ import annotations
 
@@ -43,21 +45,26 @@ class ErasureCodeJerasure(ErasureCode):
         self.w = DEFAULT_W
         self.coding_matrix: np.ndarray | None = None
 
+    DEFAULT_TECHNIQUE_W = DEFAULT_W
+
     def init(self, profile: Mapping[str, str]) -> None:
         super().init(profile)
         self.k = self.to_int("k", profile, DEFAULT_K, minimum=1)
         self.m = self.to_int("m", profile, DEFAULT_M, minimum=1)
-        self.w = self.to_int("w", profile, DEFAULT_W)
-        if self.w != 8:
-            # The TPU data path is GF(2^8)-native; other word sizes existed in
-            # jerasure for CPU table-size tradeoffs that do not apply here.
-            raise ErasureCodeError(f"w={self.w} unsupported; only w=8")
+        self.w = self.to_int("w", profile, self.DEFAULT_TECHNIQUE_W)
+        self._check_w()
         if self.k + self.m > 256:
             raise ErasureCodeError("k+m must be <= 256 in GF(2^8)")
         self._check_technique()
         self.prepare()
         # normalize defaulted keys back into the profile like the reference
         self._profile.update({"k": str(self.k), "m": str(self.m), "w": str(self.w)})
+
+    def _check_w(self) -> None:
+        if self.w != 8:
+            # The TPU data path is GF(2^8)-native; other word sizes existed in
+            # jerasure for CPU table-size tradeoffs that do not apply here.
+            raise ErasureCodeError(f"w={self.w} unsupported; only w=8")
 
     def _check_technique(self) -> None:
         pass
@@ -130,6 +137,78 @@ class ErasureCodeJerasureCauchyGood(ErasureCodeJerasure):
         return gf256.cauchy_good_matrix(self.k, self.m)
 
 
+class ErasureCodeJerasureBitMatrix(ErasureCodeJerasure):
+    """Base for the minimal-density GF(2) bitmatrix RAID-6 family
+    (liberation/blaum_roth/liber8tion): m=2, word size w, chunk = w
+    contiguous packets. Lowers onto ceph_tpu.ec.bitmatrix rather than
+    the GF(2^8) codec (these codes are not GF(2^8) matrices)."""
+
+    def _check_w(self) -> None:
+        pass            # per-technique constraints in _check_technique
+
+    def _check_technique(self) -> None:
+        if self.m != 2:
+            raise ErasureCodeError(f"{self.technique} requires m=2")
+        if self.k > self.w:
+            raise ErasureCodeError(
+                f"{self.technique}: k={self.k} > w={self.w}")
+
+    def prepare(self) -> None:
+        from ceph_tpu.ec import bitmatrix
+        self.code = bitmatrix.RAID6BitCode(
+            "blaum_roth" if self.technique == "blaum_roth"
+            else "liberation", self.k, self.w)
+
+    def get_alignment(self) -> int:
+        # chunks must split into w equal packets; keep packets themselves
+        # 64-byte aligned for the XOR path
+        return self.w * 64
+
+    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
+        self.code.encode(chunks)
+
+    def decode_chunks(self, want_to_read: Iterable[int],
+                      chunks: dict[int, np.ndarray],
+                      available: set[int]) -> None:
+        want = sorted(set(want_to_read) - available)
+        if not want:
+            return
+        self.code.decode(want, chunks, available)
+
+
+class ErasureCodeJerasureLiberation(ErasureCodeJerasureBitMatrix):
+    technique = "liberation"
+    DEFAULT_TECHNIQUE_W = 7
+
+    def _check_technique(self) -> None:
+        super()._check_technique()
+        from ceph_tpu.ec.bitmatrix import _is_prime
+        if not _is_prime(self.w):
+            raise ErasureCodeError(f"liberation: w={self.w} must be prime")
+
+
+class ErasureCodeJerasureBlaumRoth(ErasureCodeJerasureBitMatrix):
+    technique = "blaum_roth"
+    DEFAULT_TECHNIQUE_W = 6
+
+    def _check_technique(self) -> None:
+        super()._check_technique()
+        from ceph_tpu.ec.bitmatrix import _is_prime
+        if not _is_prime(self.w + 1):
+            raise ErasureCodeError(
+                f"blaum_roth: w+1={self.w + 1} must be prime")
+
+
+class ErasureCodeJerasureLiber8tion(ErasureCodeJerasureBitMatrix):
+    technique = "liber8tion"
+    DEFAULT_TECHNIQUE_W = 8
+
+    def _check_technique(self) -> None:
+        if self.w != 8:
+            raise ErasureCodeError("liber8tion requires w=8")
+        super()._check_technique()
+
+
 _TECHNIQUES = {
     cls.technique: cls
     for cls in (
@@ -137,10 +216,13 @@ _TECHNIQUES = {
         ErasureCodeJerasureReedSolomonRAID6,
         ErasureCodeJerasureCauchyOrig,
         ErasureCodeJerasureCauchyGood,
+        ErasureCodeJerasureLiberation,
+        ErasureCodeJerasureBlaumRoth,
+        ErasureCodeJerasureLiber8tion,
     )
 }
 
-_DEFERRED = {"liberation", "blaum_roth", "liber8tion"}
+_DEFERRED: set[str] = set()
 
 
 class ErasureCodePluginJerasure(ErasureCodePlugin):

@@ -210,7 +210,13 @@ class OSDMonitor:
             # "indep"; OSDMonitor crush_rule_create_erasure :7470)
             crush.make_simple_rule(rule_id, f"{name}_rule", "default",
                                    crush_failure_domain, mode="indep")
-            stripe_width = k * 4096
+            # chunk size honors the plugin's alignment (the reference
+            # derives stripe_width through get_chunk_size the same way,
+            # OSDMonitor prepare_new_pool): bitmatrix techniques need
+            # chunks divisible by w, not just SIMD-aligned
+            align = ec.get_alignment()
+            chunk = -(-4096 // align) * align
+            stripe_width = k * chunk
         else:
             min_size = max(1, size - 1)
             crush.make_simple_rule(rule_id, f"{name}_rule", "default",

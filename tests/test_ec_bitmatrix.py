@@ -1,0 +1,145 @@
+"""Bitmatrix RAID-6 family: liberation / blaum_roth / liber8tion
+(r4 verdict item #10; reference ErasureCodeJerasure.cc:353 bitmatrix
+technique dispatch).
+
+The MDS property is verified exhaustively: every 1- and 2-erasure
+pattern over (data..., P, Q) must reconstruct bit-exactly."""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import registry
+from ceph_tpu.ec.bitmatrix import (RAID6BitCode, blaum_roth_blocks,
+                                   gf2_apply, gf2_solve,
+                                   liberation_family_blocks)
+from ceph_tpu.ec.interface import ErasureCodeError
+
+
+def _factory(tech, k, w):
+    return registry.factory("jerasure", {
+        "plugin": "jerasure", "technique": tech,
+        "k": str(k), "m": "2", "w": str(w)})
+
+
+@pytest.mark.parametrize("tech,k,w", [
+    ("liberation", 3, 7), ("liberation", 7, 7), ("liberation", 5, 5),
+    ("blaum_roth", 4, 6), ("blaum_roth", 6, 6), ("blaum_roth", 5, 10),
+    ("liber8tion", 3, 8), ("liber8tion", 5, 8),
+])
+def test_all_erasure_patterns_roundtrip(tech, k, w):
+    ec = _factory(tech, k, w)
+    data = bytes((i * 7 + 13) % 256 for i in range(k * w * 16 + 5))
+    enc = ec.encode(range(k + 2), data)
+    n = k + 2
+    for r in (1, 2):
+        for erased in itertools.combinations(range(n), r):
+            chunks = {i: b for i, b in enc.items() if i not in erased}
+            out = ec.decode(list(erased), chunks, len(enc[0]))
+            for e in erased:
+                assert out[e] == enc[e], (tech, k, w, erased)
+    # concat decode restores the payload through the pad
+    got = ec.decode_concat({i: enc[i] for i in range(1, k + 2)},
+                           len(enc[0]))
+    assert got[:len(data)] == data
+
+
+def test_blaum_roth_requires_prime_w_plus_1():
+    with pytest.raises(ErasureCodeError):
+        _factory("blaum_roth", 4, 7)        # 8 not prime
+    with pytest.raises(ErasureCodeError):
+        blaum_roth_blocks(9, 8)
+
+
+def test_liberation_requires_prime_w():
+    with pytest.raises(ErasureCodeError):
+        _factory("liberation", 4, 6)
+
+
+def test_liber8tion_constraints():
+    with pytest.raises(ErasureCodeError):
+        _factory("liber8tion", 4, 7)        # w must be 8
+    with pytest.raises(ErasureCodeError):
+        _factory("liber8tion", 7, 8)        # beyond supported k
+    with pytest.raises(ErasureCodeError):
+        registry.factory("jerasure", {
+            "plugin": "jerasure", "technique": "liberation",
+            "k": "3", "m": "3", "w": "7"})  # RAID-6 family is m=2 only
+
+
+def test_minimal_density():
+    """The liberation property: disk 0 contributes w ones, every other
+    disk w+1 (prime w) — lowest possible density for an MDS RAID-6
+    bitmatrix code."""
+    for k, w in [(5, 5), (7, 7)]:
+        blocks = liberation_family_blocks(k, w)
+        assert int(blocks[0].sum()) == w
+        for b in blocks[1:]:
+            assert int(b.sum()) == w + 1, (k, w)
+
+
+def test_gf2_solve_roundtrip():
+    rng = np.random.default_rng(3)
+    for _ in range(20):
+        n = 12
+        while True:
+            A = rng.integers(0, 2, size=(n, n)).astype(np.uint8)
+            try:
+                inv = gf2_solve(A, np.eye(n, dtype=np.uint8))
+                break
+            except ErasureCodeError:
+                continue
+        assert ((A.astype(int) @ inv.astype(int)) % 2
+                == np.eye(n, dtype=int)).all()
+
+
+def test_packet_layout_stability():
+    """On-disk stability: the encoding of a fixed payload is pinned, so
+    a construction change (different searched bit placement) fails
+    loudly instead of silently breaking decode of stored chunks."""
+    from ceph_tpu.native import ec_native
+    ec = _factory("liberation", 4, 7)
+    data = bytes(range(256)) * 14
+    enc = ec.encode(range(6), data)
+    crcs = [ec_native.crc32c(enc[i]) for i in range(6)]
+    assert crcs == [2763749271, 1839738498, 2763749271, 1839738498,
+                    225952960, 2023453278], crcs
+
+
+def test_liberation_pool_end_to_end(tmp_path):
+    """The bitmatrix family must work through the OSD data path: pool
+    stripe_width honors the plugin's alignment (chunk divisible by w),
+    writes stripe-encode, degraded reads reconstruct."""
+    import asyncio
+    from tests.test_cluster import ClusterHarness, run
+
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=5)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.command({"prefix": "osd erasure-code-profile set",
+                              "name": "libprof",
+                              "profile": {"plugin": "jerasure",
+                                          "technique": "liberation",
+                                          "k": "3", "m": "2", "w": "7"}})
+            await cl.pool_create("libpool", pg_num=2, pool_type="erasure",
+                                 erasure_code_profile="libprof")
+            pool = cl.osdmap.get_pool("libpool")
+            assert pool.stripe_width % (3 * 7) == 0, pool.stripe_width
+            io = cl.ioctx("libpool")
+            import os
+            payload = os.urandom(2 * pool.stripe_width + 1234)
+            await io.write_full("obj", payload)
+            assert await io.read("obj") == payload
+            await io.append("obj", b"tail" * 100)
+            assert await io.read("obj") == payload + b"tail" * 100
+            # degraded read with one shard OSD down
+            await c.kill_osd(4)
+            await c.wait_osd_down(4)
+            assert await io.read("obj") == payload + b"tail" * 100
+        finally:
+            await c.stop()
+    run(body())
