@@ -108,8 +108,13 @@ class OSD(Dispatcher):
         self._conns: dict[int, Connection] = {}
         # ops parked until their PG finishes peering (waiting_for_active,
         # src/osd/PG.cc): preserves arrival order without wedging a
-        # queue shard on a peering PG
+        # queue shard on a peering PG. Entries are (ingest_seq, conn,
+        # msg, trk) kept sorted by ingest_seq: an op re-parked from the
+        # shard queue must land BEFORE later arrivals that parked
+        # directly, or a client's ops reorder across an interval change
+        # (the reference requeues at the front for the same reason)
         self._waiting_for_active: dict[PG, list] = {}
+        self._op_seq = 0
         self._booted = asyncio.Event()
         self._hb_task: asyncio.Task | None = None
         self._scrub_task: asyncio.Task | None = None
@@ -224,7 +229,7 @@ class OSD(Dispatcher):
             pg._cancel_peering()
             pg.backend.fail_inflight("osd stopping")
         for waiting in self._waiting_for_active.values():
-            for _, _, trk in waiting:
+            for _, _, _, trk in waiting:
                 trk.finish()
         self._waiting_for_active.clear()
         await self.op_queue.stop()
@@ -303,7 +308,7 @@ class OSD(Dispatcher):
                 if pg.state == "active" or not pg.is_primary():
                     self.requeue_waiting(pg)
             else:
-                for conn, msg, trk in self._waiting_for_active.pop(
+                for seq, conn, msg, trk in self._waiting_for_active.pop(
                         pgid, []):
                     trk.finish()
                     try:
@@ -490,25 +495,28 @@ class OSD(Dispatcher):
                 f"pg={pgid.pool}.{pgid.ps} tid={p.get('tid', 0)})")
         trk = self.optracker.create(desc)
         trk.mark_event("queued")
+        self._op_seq += 1
+        seq = self._op_seq
         if pg.state != "active" or self._waiting_for_active.get(pgid):
-            # park until activation; order among parked ops is preserved
-            trk.mark_event("waiting_for_active")
-            self._waiting_for_active.setdefault(pgid, []).append(
-                (conn, msg, trk))
+            self._park_op(pgid, seq, conn, msg, trk)
             return
-        self._enqueue_op(pgid, conn, msg, trk)
+        self._enqueue_op(pgid, seq, conn, msg, trk)
 
-    def _enqueue_op(self, pgid: PG, conn: Connection, msg: MOSDOp,
-                    trk) -> None:
+    def _park_op(self, pgid: PG, seq: int, conn, msg, trk) -> None:
+        import bisect
+        trk.mark_event("waiting_for_active")
+        waiting = self._waiting_for_active.setdefault(pgid, [])
+        bisect.insort(waiting, (seq, conn, msg, trk), key=lambda e: e[0])
+
+    def _enqueue_op(self, pgid: PG, seq: int, conn: Connection,
+                    msg: MOSDOp, trk) -> None:
         async def work():
             # the PG may have left 'active' while this op sat in the
             # queue: re-park instead of wedging the shard worker on a
             # peering PG (the reference requeues into waiting_for_active)
             pg = self.pgs.get(pgid)
             if pg is not None and pg.is_primary() and pg.state != "active":
-                trk.mark_event("waiting_for_active")
-                self._waiting_for_active.setdefault(pgid, []).append(
-                    (conn, msg, trk))
+                self._park_op(pgid, seq, conn, msg, trk)
                 return
             trk.mark_event("dequeued")
             token = set_current_op(trk)
@@ -521,14 +529,14 @@ class OSD(Dispatcher):
 
     def requeue_waiting(self, pg: PGInstance) -> None:
         """PG activation (or loss of primacy) drains its parked ops in
-        arrival order (the reference requeues waiting_for_active)."""
+        ingest order (the reference requeues waiting_for_active)."""
         waiting = self._waiting_for_active.pop(pg.pgid, None)
         if not waiting:
             return
-        for conn, msg, trk in waiting:
+        for seq, conn, msg, trk in waiting:
             if pg.is_primary() and pg.state == "active":
                 trk.mark_event("requeued_after_activation")
-                self._enqueue_op(pg.pgid, conn, msg, trk)
+                self._enqueue_op(pg.pgid, seq, conn, msg, trk)
             else:
                 trk.mark_event("dropped_not_primary")
                 trk.finish()
@@ -555,7 +563,11 @@ class OSD(Dispatcher):
         try:
             results = []
             outdata = b""
-            for op in p.get("ops", []):
+            for i, op in enumerate(p.get("ops", [])):
+                if p.get("reqid"):
+                    # one dedup key per op within the message: multi-op
+                    # messages must not collide in the dup index
+                    op = dict(op, reqid=[*p["reqid"], i])
                 rc, out, opdata = await pg.do_op(op, msg.data)
                 results.append({"rc": rc, "out": out})
                 outdata += opdata

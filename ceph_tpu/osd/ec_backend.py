@@ -49,6 +49,15 @@ from ceph_tpu.utils.work_queue import mark_op_event
 
 READ_TIMEOUT = 5.0
 
+# shard-side rollback generation: before every sub-write apply, the
+# current shard state is cloned to <oid>+PREV_SUFFIX. A divergent chain
+# of partial fan-outs can otherwise fragment shard versions until NO
+# version holds k chunks — with in-place overwrites the old consistent
+# stripes would be gone for good (the reference keeps rollback extents
+# in ECTransaction / rolls forward via ECDummyOp for the same reason;
+# found by the thrashing model checker).
+PREV_SUFFIX = "\x00prev"
+
 
 class ECBackend(PGBackend):
     """Erasure-coded writes/reads over the acting set's shard positions."""
@@ -103,10 +112,13 @@ class ECBackend(PGBackend):
                 "version": json.dumps(list(version)).encode()}
 
     def _verified_local_extent(
-            self, oid: str, chunk_off: int,
-            chunk_len: int) -> tuple[bytes, int, int, tuple] | None:
+            self, oid: str, chunk_off: int, chunk_len: int,
+            prev: bool = False) -> tuple[bytes, int, int, tuple] | None:
         """Read [chunk_off, chunk_off+chunk_len) of the local shard blob
-        with per-chunk crc verification; None if absent or corrupt."""
+        (or its rollback generation) with per-chunk crc verification;
+        None if absent or corrupt."""
+        if prev:
+            oid = oid + PREV_SUFFIX
         if not self.local_exists(oid):
             return None
         cid, gh = self.coll(), self.ghobject(oid)
@@ -289,9 +301,23 @@ class ECBackend(PGBackend):
         await asyncio.wait_for(fut, SUBOP_TIMEOUT)
         mark_op_event("commit")
 
+    def _stash_prev(self, oid: str) -> None:
+        """Clone the current shard state to the rollback generation."""
+        cid = self.coll()
+        gh, pgh = self.ghobject(oid), self.ghobject(oid + PREV_SUFFIX)
+        if not self.host.store.exists(cid, gh):
+            return
+        from ceph_tpu.objectstore.store import Transaction
+        txn = Transaction()
+        if self.host.store.exists(cid, pgh):
+            txn.remove(cid, pgh)
+        txn.clone(cid, gh, pgh)
+        self.host.store.queue_transaction(txn)
+
     def _apply_sub_write(self, oid: str, shard: int, sub: dict,
                          chunk: bytes) -> None:
         kind = sub["op"]
+        self._stash_prev(oid)
         if kind == "write_full":
             attrs = {k: v.encode("latin1") for k, v in sub["attrs"].items()}
             self.local_apply(oid, "push", chunk, attrs=attrs)
@@ -460,6 +486,15 @@ class ECBackend(PGBackend):
             for fut, tid in waits.items():
                 fut.cancel()
                 self._read_waiters.pop(tid, None)
+        if best() is None and by_version and allow_rollback:
+            # no MAIN version is decodable: a chain of partial fan-outs
+            # fragmented the shard versions. Pull the shards' rollback
+            # generations — every sub-write stashed its predecessor — so
+            # an older consistent version can be reassembled instead of
+            # wedging peering forever (the reference's rollback-extent
+            # machinery serves the same purpose)
+            await self._gather_prev_pass(oid, exclude_osds, chunk_off,
+                                         chunk_len, add)
         ver = best()
         if ver is None:
             if not by_version:
@@ -489,6 +524,54 @@ class ECBackend(PGBackend):
         any_shard = next(iter(shards.values()))
         return got, any_shard[1], {"version": ver,
                                    "rolled_back": rolled_back}
+
+    async def _gather_prev_pass(self, oid: str, exclude_osds: frozenset,
+                                chunk_off: int, chunk_len: int,
+                                add) -> None:
+        """One round asking every live shard for its rollback
+        generation; results merge into the caller's version table."""
+        if self.host.whoami not in exclude_osds:
+            loc = self._verified_local_extent(oid, chunk_off, chunk_len,
+                                              prev=True)
+            if loc is not None:
+                data, shard, size, ver = loc
+                add(shard, data, size, ver)
+        waits: dict[asyncio.Future, int] = {}
+        pending: set = set()
+        for idx, osd in sorted(self._live_positions().items()):
+            if osd == self.host.whoami or osd in exclude_osds:
+                continue
+            tid = self.new_tid()
+            fut = asyncio.get_running_loop().create_future()
+            self._read_waiters[tid] = fut
+            waits[fut] = tid
+            try:
+                await self.host.send_osd(osd, MOSDECSubOpRead(
+                    {"pgid": [self.pg.pgid.pool, self.pg.pgid.ps],
+                     "tid": tid, "from": self.host.whoami, "oid": oid,
+                     "chunk_off": chunk_off, "chunk_len": chunk_len,
+                     "prev": True}))
+                pending.add(fut)
+            except Exception:
+                fut.cancel()
+        try:
+            deadline = asyncio.get_running_loop().time() + READ_TIMEOUT / 2
+            while pending:
+                timeout = deadline - asyncio.get_running_loop().time()
+                if timeout <= 0:
+                    break
+                done, pending = await asyncio.wait(
+                    pending, timeout=timeout,
+                    return_when=asyncio.ALL_COMPLETED)
+                for fut in done:
+                    payload, data = fut.result()
+                    if payload.get("found"):
+                        add(payload["shard"], data, payload["ec_size"],
+                            payload.get("version", (0, 0)))
+        finally:
+            for fut, tid in waits.items():
+                fut.cancel()
+                self._read_waiters.pop(tid, None)
 
     async def execute_read(self, oid: str, offset: int,
                            length: int) -> bytes:
@@ -554,7 +637,8 @@ class ECBackend(PGBackend):
                    "from": self.host.whoami, "oid": p["oid"],
                    "found": False, "shard": -1, "ec_size": -1}
         loc = self._verified_local_extent(
-            p["oid"], p.get("chunk_off", 0), p.get("chunk_len", -1))
+            p["oid"], p.get("chunk_off", 0), p.get("chunk_len", -1),
+            prev=p.get("prev", False))
         data = b""
         if loc is not None:
             data, shard, size, ver = loc
@@ -575,12 +659,17 @@ class ECBackend(PGBackend):
     # -- recovery (RecoveryOp-lite: reconstruct + push) ----------------------
 
     async def _rewrite_consistent(self, oid: str, got: dict[int, bytes],
-                                  ec_size: int) -> None:
+                                  ec_size: int, rolled_to: tuple) -> None:
         """Converge every live shard on one consistent state by
         re-asserting the rolled-back content as a fresh full write: a
         divergent partial fan-out leaves SOME shards at the newer
         version, and reconstructing just one position would leave the
         acting set mixed (every later read would EIO)."""
+        # log entries NEWER than the surviving content were rolled back:
+        # their reqids must leave the dup index, or the client's retry
+        # of that very write would be answered "already done" while its
+        # data is gone (found by the thrashing model checker)
+        self.pg.log.invalidate_reqids_for(oid, newer_than=rolled_to)
         data = ec_util.decode_concat(self.sinfo, self.ec_impl,
                                      got)[:ec_size]
         version = self.pg.next_version()
@@ -593,15 +682,20 @@ class ECBackend(PGBackend):
     async def _reconstruct(self, oid: str, idx: int,
                            exclude: frozenset) -> tuple[bytes, dict] | None:
         """Chunk for position `idx` + its attrs, reconstructed from any k
-        survivors (never from the target itself — its copy may be stale).
-        None when the acting set was instead converged by a divergence
-        rewrite (the caller's push is already done). Transient <k
-        availability (EIO with no rollback possible) propagates so
-        peering retries instead of recording a deletion."""
+        version-consistent survivors — INCLUDING the target itself when
+        its chunk is crc-valid at the needed version (version attrs keep
+        stale copies from combining; a target holding the newest version
+        must count toward decodability or partial fan-outs look
+        rollback-worthy when they are not). None when the acting set was
+        instead converged by a divergence rewrite (the caller's push is
+        already done). Transient <k availability (EIO with no rollback
+        possible) propagates so peering retries instead of recording a
+        deletion."""
         got, ec_size, meta = await self._gather_chunks(
             oid, exclude_osds=exclude, allow_rollback=True)
         if meta["rolled_back"]:
-            await self._rewrite_consistent(oid, got, ec_size)
+            await self._rewrite_consistent(oid, got, ec_size,
+                                           meta["version"])
             return None
         if idx in got:
             chunk = got[idx]
@@ -620,8 +714,13 @@ class ECBackend(PGBackend):
         except ValueError:
             return
         try:
-            rec = await self._reconstruct(oid, idx,
-                                          exclude=frozenset([peer]))
+            # the target is NOT excluded from the gather: version attrs
+            # keep a stale copy from combining with newer shards, and the
+            # per-chunk crc gate keeps a corrupt one out — but a target
+            # holding the newest version must still count toward its
+            # decodability, or a partial fan-out looks rollback-worthy
+            # when it is not (found by the thrashing model checker)
+            rec = await self._reconstruct(oid, idx, exclude=frozenset())
         except StoreError as e:
             if e.code != "ENOENT":
                 raise
@@ -638,8 +737,7 @@ class ECBackend(PGBackend):
         chunk is a different position)."""
         me = self.pg.acting.index(self.host.whoami)
         try:
-            rec = await self._reconstruct(
-                oid, me, exclude=frozenset([self.host.whoami]))
+            rec = await self._reconstruct(oid, me, exclude=frozenset())
         except StoreError as e:
             if e.code != "ENOENT":
                 raise

@@ -141,9 +141,11 @@ class PGInstance:
         self.last_epoch_started = meta.get("les", 0)
 
     def list_objects(self) -> list[str]:
+        from ceph_tpu.osd.ec_backend import PREV_SUFFIX
         cid = self.backend.coll()
         return sorted(gh.name for gh in self.host.store.collection_list(cid)
-                      if gh.name != PGMETA_OID)
+                      if gh.name != PGMETA_OID
+                      and not gh.name.endswith(PREV_SUFFIX))
 
     # -- map advance ---------------------------------------------------------
 
@@ -260,7 +262,15 @@ class PGInstance:
                 missing = self.log.merge_log(auth_entries, auth_head)
                 self.seq = max(self.seq, self.log.head[1])
                 for oid, need in missing.items():
-                    await self.backend.pull_object(auth_osd, oid, need)
+                    if tuple(need) == ZERO:
+                        # rewind-to-none tombstone: the authoritative
+                        # history DELETED this object — reconstructing it
+                        # from surviving shards (or their rollback
+                        # generations) would resurrect an acked delete
+                        # (found by the thrashing model checker)
+                        self.backend.local_apply(oid, "delete", b"")
+                    else:
+                        await self.backend.pull_object(auth_osd, oid, need)
                 self.log.clear_missing()
 
         # Activate: bring every replica to the authoritative state
@@ -328,6 +338,7 @@ class PGInstance:
         new_log = PGLog()
         new_log.entries = list(auth_entries)
         new_log.head, new_log.tail = auth_head, auth_tail
+        new_log._rebuild_reqids()
         self.log = new_log
         self.seq = max(self.seq, auth_head[1])
 
@@ -561,8 +572,27 @@ class PGInstance:
 
     async def _do_modify(self, kind: str, oid: str, op: dict,
                          data: bytes) -> tuple[int, dict, bytes]:
-        await asyncio.wait_for(self._write_gate.wait(), 30.0)
-        self._active_writes += 1
+        reqid = tuple(op["reqid"]) if op.get("reqid") else None
+        if reqid is not None:
+            done_ver = self.log.lookup_reqid(reqid)
+            if done_ver is not None:
+                # client retry of an op that already committed (its reply
+                # was lost in a failover): answer from the log instead of
+                # re-executing — appends would double-apply, deletes
+                # would answer ENOENT for a success (PrimaryLogPG dup-op
+                # check via the pg log's reqid index)
+                return 0, {"version": list(done_ver), "dup": True}, b""
+        deadline = asyncio.get_running_loop().time() + 30.0
+        while True:
+            await asyncio.wait_for(
+                self._write_gate.wait(),
+                max(0.1, deadline - asyncio.get_running_loop().time()))
+            if self._write_gate.is_set():
+                # the is_set re-check + increment run in one resume slice
+                # (no await between), so block_writes cannot observe a
+                # zero counter while this write proceeds (TOCTOU)
+                self._active_writes += 1
+                break
         try:
             return await self._do_modify_inner(kind, oid, op, data)
         finally:
@@ -602,7 +632,9 @@ class PGInstance:
         version = self.next_version()
         entry = LogEntry(version=version,
                          op="delete" if kind == "delete" else "modify",
-                         oid=oid, prior_version=self._prior(oid))
+                         oid=oid, prior_version=self._prior(oid),
+                         reqid=tuple(op["reqid"]) if op.get("reqid")
+                         else None)
         await self.backend.execute_write(oid, kind, data, entry,
                                          off=op.get("off", 0))
         self.log.append(entry)

@@ -25,21 +25,31 @@ ZERO: Eversion = (0, 0)
 
 @dataclasses.dataclass
 class LogEntry:
-    """pg_log_entry_t-lite: what happened to which object, when."""
+    """pg_log_entry_t-lite: what happened to which object, when.
+
+    `reqid` is the client's stable request id (nonce, seq) — the dup-op
+    index key (osd_reqid_t in pg_log_entry_t): a client retry whose
+    first attempt actually committed must NOT re-execute (appends would
+    double-apply, deletes would answer ENOENT for a success)."""
 
     version: Eversion
     op: str                     # "modify" | "delete"
     oid: str                    # object name within the PG
     prior_version: Eversion = ZERO
+    reqid: tuple | None = None
 
     def to_dict(self) -> dict:
-        return {"version": list(self.version), "op": self.op,
-                "oid": self.oid, "prior_version": list(self.prior_version)}
+        d = {"version": list(self.version), "op": self.op,
+             "oid": self.oid, "prior_version": list(self.prior_version)}
+        if self.reqid is not None:
+            d["reqid"] = list(self.reqid)
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "LogEntry":
         return cls(version=tuple(d["version"]), op=d["op"], oid=d["oid"],
-                   prior_version=tuple(d.get("prior_version", [0, 0])))
+                   prior_version=tuple(d.get("prior_version", [0, 0])),
+                   reqid=tuple(d["reqid"]) if d.get("reqid") else None)
 
 
 class PGLog:
@@ -54,6 +64,9 @@ class PGLog:
         # oid -> (need version, have prior) — objects this replica must
         # recover before it can serve them (pg_missing_t)
         self.missing: dict[str, Eversion] = {}
+        # dup-op index: reqid -> version of the entry that executed it
+        # (PGLog dups; horizon = the retained entry window)
+        self._reqids: dict[tuple, Eversion] = {}
 
     # -- append path ---------------------------------------------------------
 
@@ -61,10 +74,37 @@ class PGLog:
         assert entry.version > self.head, (entry, self.head)
         self.entries.append(entry)
         self.head = entry.version
+        if entry.reqid is not None:
+            self._reqids[entry.reqid] = entry.version
         if len(self.entries) > self.MAX_ENTRIES:
             drop = len(self.entries) - self.MAX_ENTRIES
             self.tail = self.entries[drop - 1].version
+            for e in self.entries[:drop]:
+                if e.reqid is not None:
+                    self._reqids.pop(e.reqid, None)
             del self.entries[:drop]
+
+    def lookup_reqid(self, reqid: tuple) -> Eversion | None:
+        """Version recorded for a client request id, if it already
+        executed within the retained log window (dup-op detection)."""
+        return self._reqids.get(reqid)
+
+    def _rebuild_reqids(self) -> None:
+        self._reqids = {e.reqid: e.version for e in self.entries
+                        if e.reqid is not None}
+
+    def invalidate_reqids_for(self, oid: str, newer_than: Eversion) -> None:
+        """Divergence rollback rewound this object past these entries:
+        their requests did NOT survive, so retries must re-execute
+        rather than be answered from the dup index. The reqid is
+        stripped from the ENTRY too — _rebuild_reqids (log reload,
+        authoritative merge) would otherwise resurrect the stale dup
+        answer."""
+        for e in self.entries:
+            if e.oid == oid and e.version > newer_than \
+                    and e.reqid is not None:
+                self._reqids.pop(e.reqid, None)
+                e.reqid = None
 
     # -- peering -------------------------------------------------------------
 
@@ -140,4 +180,5 @@ class PGLog:
         log.head = tuple(d.get("head", [0, 0]))
         log.missing = {o: tuple(v)
                        for o, v in d.get("missing", {}).items()}
+        log._rebuild_reqids()
         return log

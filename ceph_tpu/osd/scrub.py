@@ -55,6 +55,8 @@ async def build_scrub_map(pg: "PGInstance", deep: bool) -> dict:
     from ceph_tpu.native import ec_native
     store = pg.host.store
     cid = pg.backend.coll()
+    if pg.pool.type == "erasure":
+        _gc_rollback_generations(pg)
     out: dict[str, dict] = {}
     for i, oid in enumerate(pg.list_objects()):
         if i % _SCAN_YIELD_EVERY == _SCAN_YIELD_EVERY - 1:
@@ -97,6 +99,24 @@ async def build_scrub_map(pg: "PGInstance", deep: bool) -> dict:
             ent["corrupt"] = True
         out[oid] = ent
     return out
+
+
+def _gc_rollback_generations(pg: "PGInstance") -> None:
+    """Drop EC rollback generations (<oid>\\x00prev clones) whose main
+    object is gone: scrub only runs on a healthy active PG with writes
+    gated, so any divergence that could have needed them has already
+    been resolved by peering. (Prevents deleted objects from leaking a
+    prev clone forever.)"""
+    from ceph_tpu.objectstore.store import Transaction
+    from ceph_tpu.osd.ec_backend import PREV_SUFFIX
+    store = pg.host.store
+    cid = pg.backend.coll()
+    live = set(pg.list_objects())
+    for gh in list(store.collection_list(cid)):
+        if not gh.name.endswith(PREV_SUFFIX):
+            continue
+        if gh.name[:-len(PREV_SUFFIX)] not in live:
+            store.queue_transaction(Transaction().remove(cid, gh))
 
 
 async def scrub_pg(pg: "PGInstance", deep: bool) -> dict:

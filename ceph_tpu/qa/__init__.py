@@ -1,0 +1,9 @@
+"""QA harness: model-based random-op consistency checking + thrashing.
+
+The reference's core correctness methodology (src/test/osd/RadosModel.h
+random-op model checker, qa/tasks/ceph_manager.py:338 kill_osd /
+:552 revive_osd thrashing) re-created for this stack.
+"""
+from ceph_tpu.qa.rados_model import ModelRunner, Thrasher
+
+__all__ = ["ModelRunner", "Thrasher"]

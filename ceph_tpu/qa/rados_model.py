@@ -1,0 +1,241 @@
+"""Model-based random-op checker + OSD thrasher.
+
+Re-creation of the reference's RadosModel methodology
+(src/test/osd/RadosModel.h): drive a random mix of object ops against a
+live cluster while maintaining an in-memory truth model, and verify the
+cluster converges to the model. The thrasher (qa/tasks/ceph_manager.py:
+338 kill_osd, :552 revive_osd) kills and revives OSDs underneath the
+workload, so every op races failure detection, re-peering, log-driven
+recovery, and (on EC pools) reconstruction.
+
+Op outcomes that cannot be known (timeouts mid-failover) park the
+object in an UNCERTAIN state holding both candidate values — the same
+bookkeeping RadosModel does for in-flight ops at kill time — and the
+final check accepts either; any later successful op collapses the
+uncertainty.
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+
+from ceph_tpu.rados import ObjectNotFound, RadosError
+from ceph_tpu.utils.dout import dout
+
+
+class ModelRunner:
+    """Random-op workload + in-memory truth for ONE pool."""
+
+    def __init__(self, io, rng: random.Random, ec_pool: bool,
+                 stripe: int = 8192, max_objects: int = 24):
+        self.io = io
+        self.rng = rng
+        self.ec = ec_pool
+        self.w = stripe
+        self.max_objects = max_objects
+        self.model: dict[str, bytearray] = {}
+        # oid -> tuple of acceptable states (bytes or None=absent)
+        self.uncertain: dict[str, tuple] = {}
+        self.ops_run = 0
+        self.uncertain_ops = 0
+
+    def _oid(self) -> str:
+        return f"m{self.rng.randrange(self.max_objects):03d}"
+
+    def _payload(self) -> bytes:
+        n = self.rng.choice([1, 17, 100, self.w // 2, self.w,
+                             self.w + 13, 3 * self.w - 5])
+        return self.rng.randbytes(n)
+
+    async def _mutate(self, oid: str, coro, new_state) -> None:
+        """Run one mutation; keep the model exact on success, fork it on
+        an unknowable outcome."""
+        old_state = bytes(self.model[oid]) if oid in self.model else None
+        try:
+            await coro
+        except ObjectNotFound:
+            # deterministic failure: nothing changed
+            return
+        except (RadosError, TimeoutError, asyncio.TimeoutError) as e:
+            self.uncertain_ops += 1
+            dout("qa", 3, f"model: {oid} outcome unknown ({e})")
+            self.uncertain[oid] = (old_state,
+                                   bytes(new_state)
+                                   if new_state is not None else None)
+            if new_state is None:
+                self.model.pop(oid, None)
+            return
+        self.uncertain.pop(oid, None)
+        if new_state is None:
+            self.model.pop(oid, None)
+        else:
+            self.model[oid] = bytearray(new_state)
+
+    async def step(self) -> None:
+        self.ops_run += 1
+        oid = self._oid()
+        roll = self.rng.random()
+        cur = self.model.get(oid)
+        if oid in self.uncertain and roll < 0.65:
+            # appends/ranged writes on an uncertain object would fork the
+            # model unboundedly (the base is unknown); collapse with a
+            # full-state write instead — RadosModel resolves in-flight
+            # ambiguity the same way
+            roll = 0.0
+        if roll < 0.25:
+            data = self._payload()
+            await self._mutate(oid, self.io.write_full(oid, data), data)
+        elif roll < 0.45:
+            data = self._payload()
+            new = bytearray(cur or b"")
+            new += data
+            await self._mutate(oid, self.io.append(oid, data), new)
+        elif roll < 0.65:
+            data = self._payload()
+            off = self.rng.randrange(0, len(cur) + self.w if cur else
+                                     2 * self.w)
+            new = bytearray(cur or b"")
+            if off > len(new):
+                new += b"\0" * (off - len(new))
+            new[off:off + len(data)] = data
+            await self._mutate(oid, self.io.write(oid, data, offset=off),
+                               new)
+        elif roll < 0.75:
+            if oid in self.model or oid in self.uncertain:
+                await self._mutate(oid, self.io.remove(oid), None)
+        elif roll < 0.9:
+            await self._check_read(oid)
+        else:
+            await self._check_stat(oid)
+
+    # -- verification ----------------------------------------------------
+
+    def _acceptable(self, oid: str) -> tuple:
+        if oid in self.uncertain:
+            return self.uncertain[oid]
+        return (bytes(self.model[oid]) if oid in self.model else None,)
+
+    async def _check_read(self, oid: str) -> None:
+        accept = self._acceptable(oid)
+        try:
+            data = await self.io.read(oid)
+        except ObjectNotFound:
+            assert None in accept, \
+                f"{oid}: cluster says ENOENT, model says " \
+                f"{[len(a) if a is not None else None for a in accept]}"
+            return
+        except (RadosError, TimeoutError, asyncio.TimeoutError):
+            return              # transiently unreadable mid-thrash: skip
+        ok = any(a is not None and bytes(a) == data for a in accept)
+        assert ok, (f"{oid}: read {len(data)}B != model "
+                    f"{[len(a) if a is not None else None for a in accept]}")
+
+    async def _check_stat(self, oid: str) -> None:
+        accept = self._acceptable(oid)
+        try:
+            st = await self.io.stat(oid)
+        except ObjectNotFound:
+            assert None in accept, f"{oid}: ENOENT vs model"
+            return
+        except (RadosError, TimeoutError, asyncio.TimeoutError):
+            return
+        sizes = {len(a) for a in accept if a is not None}
+        assert st["size"] in sizes, f"{oid}: size {st['size']} != {sizes}"
+
+    async def final_check(self, attempts: int = 6,
+                          delay: float = 2.0) -> None:
+        """Quiesced cluster must equal the model exactly (modulo
+        uncertain objects, which may hold either candidate). Retries:
+        recovery may still be converging right after the thrasher
+        stops."""
+        last_err: AssertionError | None = None
+        for i in range(attempts):
+            try:
+                await self._final_once()
+                return
+            except AssertionError as e:
+                last_err = e
+                await asyncio.sleep(delay)
+        raise last_err
+
+    async def _final_once(self) -> None:
+        for oid in sorted(set(self.model) | set(self.uncertain)):
+            accept = self._acceptable(oid)
+            try:
+                data = await self.io.read(oid)
+            except ObjectNotFound:
+                assert None in accept, f"{oid}: lost (model has it)"
+                continue
+            except (RadosError, TimeoutError, asyncio.TimeoutError) as e:
+                # still converging: retryable, not a verdict
+                raise AssertionError(f"{oid}: unreadable ({e})")
+            assert any(a is not None and bytes(a) == data
+                       for a in accept), \
+                f"{oid}: content mismatch ({len(data)}B)"
+        listed = set(await self.io.list_objects())
+        must_exist = {o for o in self.model if o not in self.uncertain}
+        may_exist = set(self.uncertain) | set(self.model)
+        missing = must_exist - listed
+        stray = listed - may_exist
+        assert not missing, f"objects lost: {sorted(missing)}"
+        assert not stray, f"objects resurrected: {sorted(stray)}"
+
+
+class Thrasher:
+    """Kill/revive OSDs under the workload (ceph_manager.py:338,552).
+
+    Keeps at most `max_down` OSDs dead at once and always revives with
+    the same store, so recovery is log- or backfill-driven rather than
+    a blank-disk rebuild.
+    """
+
+    def __init__(self, cluster, rng: random.Random, max_down: int = 1,
+                 min_interval: float = 0.8, max_interval: float = 2.0):
+        self.c = cluster
+        self.rng = rng
+        self.max_down = max_down
+        self.min_interval = min_interval
+        self.max_interval = max_interval
+        self._task: asyncio.Task | None = None
+        self._stopping = False
+        self.kills = 0
+        self._down: dict[int, object] = {}      # osd id -> store
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        """Stop thrashing and heal the cluster (revive everything)."""
+        self._stopping = True
+        if self._task is not None:
+            await self._task
+        for i, store in sorted(self._down.items()):
+            await self.c.start_osd(i, store=store)
+        self._down.clear()
+
+    async def _run(self) -> None:
+        while not self._stopping:
+            await asyncio.sleep(self.rng.uniform(self.min_interval,
+                                                 self.max_interval))
+            if self._stopping:
+                return
+            try:
+                if self._down and (len(self._down) >= self.max_down
+                                   or self.rng.random() < 0.5):
+                    i = self.rng.choice(sorted(self._down))
+                    store = self._down.pop(i)
+                    dout("qa", 2, f"thrasher: reviving osd.{i}")
+                    await self.c.start_osd(i, store=store)
+                else:
+                    candidates = [i for i in self.c.osds
+                                  if i not in self._down]
+                    if len(candidates) <= 1:
+                        continue
+                    i = self.rng.choice(candidates)
+                    dout("qa", 2, f"thrasher: killing osd.{i}")
+                    store = self.c.osds[i].store
+                    await self.c.kill_osd(i)
+                    self._down[i] = store
+                    self.kills += 1
+            except Exception as e:
+                dout("qa", 1, f"thrasher: {type(e).__name__} {e}")

@@ -52,6 +52,12 @@ class RadosClient(Dispatcher):
         self.osdmap = OSDMap()
         self._map_changed = asyncio.Event()
         self._tid = 0
+        # client instance nonce: makes (nonce, seq) reqids globally
+        # unique so OSDs can dedup retried non-idempotent ops
+        # (osd_reqid_t semantics)
+        import secrets
+        self._nonce = secrets.randbits(48)
+        self._reqseq = 0
         self._waiters: dict[int, asyncio.Future] = {}
         self._osd_conns: dict[int, Connection] = {}
 
@@ -136,6 +142,11 @@ class RadosClient(Dispatcher):
         target PG (PG-scoped ops like `list`)."""
         deadline = time.monotonic() + (timeout or self.OP_TIMEOUT)
         last = "no attempt"
+        # one reqid per LOGICAL op, stable across retries: the PG's
+        # dup-op index keys on it, so a retry whose first attempt
+        # committed is answered from the log instead of re-executing
+        self._reqseq += 1
+        reqid = [self._nonce, self._reqseq]
         while time.monotonic() < deadline:
             if pool_name not in self.osdmap.pool_names:
                 raise RadosError(-2, f"pool {pool_name!r} does not exist")
@@ -158,7 +169,8 @@ class RadosClient(Dispatcher):
             self._waiters[tid] = fut
             conn.send_message(MOSDOp(
                 {"tid": tid, "pgid": [pg.pool, pg.ps], "oid": oid,
-                 "ops": ops, "epoch": self.osdmap.epoch}, data))
+                 "ops": ops, "reqid": reqid,
+                 "epoch": self.osdmap.epoch}, data))
             try:
                 reply = await asyncio.wait_for(
                     fut, min(self.ATTEMPT_TIMEOUT,

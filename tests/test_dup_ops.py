@@ -1,0 +1,90 @@
+"""Dup-op detection: a client retry whose first attempt committed must
+be answered from the pg log's reqid index, not re-executed
+(osd_reqid_t semantics, PrimaryLogPG dup-op check — found by the
+thrashing model checker as double-applied appends / ENOENT'd deletes).
+"""
+from __future__ import annotations
+
+import pytest
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+from tests.test_ec_rmw import make_ec_cluster
+
+
+def _primary_pg(c, pool_type):
+    for osd in c.osds.values():
+        for pg in osd.pgs.values():
+            if pg.is_primary() and pg.pool.type == pool_type \
+                    and pg.state == "active":
+                return pg
+    raise AssertionError("no active primary pg")
+
+
+@pytest.mark.parametrize("pool", ["replicated", "erasure"])
+def test_retried_append_applies_once(tmp_path, pool):
+    async def body():
+        if pool == "erasure":
+            c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3)
+        else:
+            c = ClusterHarness(tmp_path)
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=1, size=3)
+            io = cl.ioctx("rbd")
+        try:
+            await io.write_full("o", b"base")
+            pg = _primary_pg(c, pool)
+            op = {"op": "append", "oid": "o", "reqid": [777, 1, 0]}
+            rc1, out1, _ = await pg.do_op(dict(op), b"+tail")
+            assert rc1 == 0 and not out1.get("dup")
+            # the retry (same reqid) must not re-execute
+            rc2, out2, _ = await pg.do_op(dict(op), b"+tail")
+            assert rc2 == 0 and out2.get("dup"), out2
+            assert out2["version"] == out1["version"]
+            assert await io.read("o") == b"base+tail"
+
+            dop = {"op": "delete", "oid": "o", "reqid": [777, 2, 0]}
+            rc, out, _ = await pg.do_op(dict(dop), b"")
+            assert rc == 0
+            # retried delete answers success, NOT ENOENT
+            rc, out, _ = await pg.do_op(dict(dop), b"")
+            assert rc == 0 and out.get("dup"), (rc, out)
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_dup_index_survives_failover(tmp_path):
+    """The reqid index rides the replicated log entries, so a NEW
+    primary after failover still recognizes the retry."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=1, size=3)
+            io = cl.ioctx("rbd")
+            await io.write_full("o", b"base")
+            pg = _primary_pg(c, "replicated")
+            op = {"op": "append", "oid": "o", "reqid": [778, 1, 0]}
+            rc, out, _ = await pg.do_op(dict(op), b"+tail")
+            assert rc == 0
+            old_primary = pg.host.whoami
+            import asyncio
+            await c.kill_osd(old_primary)
+            await c.wait_osd_down(old_primary)
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                try:
+                    npg = _primary_pg(c, "replicated")
+                    break
+                except AssertionError:
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.1)
+            assert npg.host.whoami != old_primary
+            rc, out, _ = await npg.do_op(dict(op), b"+tail")
+            assert rc == 0 and out.get("dup"), (rc, out)
+            assert await io.read("o") == b"base+tail"
+        finally:
+            await c.stop()
+    run(body())
