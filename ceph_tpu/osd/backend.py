@@ -225,9 +225,16 @@ class PGBackend:
         else:
             await self.pg.send_push(peer, oid, b"", None, delete=True)
 
-    async def pull_object(self, auth_peer: int, oid: str, need) -> None:
-        """Fetch this object's authoritative state from `auth_peer`."""
-        await self.pg.pull_transport(auth_peer, oid)
+    async def pull_object(self, auth_peer: int, oid: str, need,
+                          fallbacks=()) -> None:
+        """Fetch this object's authoritative state from `auth_peer`,
+        trying `fallbacks` before accepting absence: a single source
+        that happens to lack the object must not tombstone a copy
+        another peer still holds."""
+        for peer in [auth_peer, *fallbacks]:
+            await self.pg.pull_transport(peer, oid)
+            if self.local_exists(oid):
+                return
 
 
 class ReplicatedBackend(PGBackend):
@@ -283,8 +290,10 @@ class ReplicatedBackend(PGBackend):
         self.local_apply(p["oid"], p["op"], msg.data, off=p.get("off", 0))
         if entry.version > self.pg.log.head:
             self.pg.log.append(entry)
-        # a full-state op supersedes whatever we were missing
-        self.pg.log.mark_recovered(p["oid"])
+        if p["op"] in ("write_full", "push", "delete", "create"):
+            # only FULL-state ops supersede a missing base; an extent
+            # write to a recovering replica leaves it missing
+            self.pg.log.mark_recovered(p["oid"])
         self.pg.persist_meta()
         conn.send_message(MOSDRepOpReply(
             {"pgid": p["pgid"], "tid": p["tid"],

@@ -33,7 +33,9 @@ from ceph_tpu.objectstore.store import StoreError
 from ceph_tpu.osd.backend import IntervalChange
 from ceph_tpu.osd.pg import PGInstance
 from ceph_tpu.utils.admin_socket import AdminSocket
+from ceph_tpu.utils.config import Config, Option
 from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.perf_counters import (TYPE_AVG, PerfCountersCollection)
 from ceph_tpu.utils.throttle import HeartbeatMap
 from ceph_tpu.utils.work_queue import (Finisher, OpTracker, ShardedOpQueue,
                                        reset_current_op, set_current_op)
@@ -50,24 +52,63 @@ class OSD(Dispatcher):
     SCRUB_INTERVAL = 60.0       # osd_scrub_min_interval analog
     DEEP_SCRUB_EVERY = 4        # every Nth scrub round goes deep
 
+    MAX_RECOVERY_IN_FLIGHT = 4  # osd_max_backfills / AsyncReserver slots
+
     def __init__(self, whoami: int, mon_addrs: list[tuple[str, int]],
                  store=None, crush_location: dict | None = None,
-                 admin_socket_path: str | None = None):
+                 admin_socket_path: str | None = None,
+                 config: Config | None = None):
         self.whoami = whoami
         self.store = store if store is not None else MemStore(f"osd{whoami}")
         self.crush_location = crush_location or {"host": f"host{whoami}"}
+        # tunables live in the Config (defaults seeded from the class
+        # attrs so test monkeypatching still works); timer loops re-read
+        # every iteration, so `config set` via the admin socket takes
+        # effect immediately (observer-free hot reload)
+        self.config = config if config is not None else Config([
+            Option("osd_heartbeat_interval", "float", self.HB_INTERVAL,
+                   "seconds between peer pings", minimum=0.01),
+            Option("osd_heartbeat_grace", "float", self.HB_GRACE,
+                   "silence before reporting a peer failed",
+                   minimum=0.05),
+            Option("osd_scrub_interval", "float", self.SCRUB_INTERVAL,
+                   "seconds between background scrub rounds",
+                   minimum=0.05),
+            Option("osd_deep_scrub_every", "int", self.DEEP_SCRUB_EVERY,
+                   "every Nth scrub round re-reads data", minimum=1),
+            Option("osd_op_num_shards", "int", self.NUM_OP_SHARDS,
+                   "op queue shards (startup only)", minimum=1),
+            Option("osd_max_recovery_in_flight", "int",
+                   self.MAX_RECOVERY_IN_FLIGHT,
+                   "host-wide recovery reservation slots (startup only)",
+                   minimum=1),
+        ])
+        # per-daemon perf counters, served by `perf dump` (the admin
+        # socket reads the process-wide collection)
+        coll = PerfCountersCollection.instance()
+        coll.remove(f"osd.{whoami}")    # a restarted id re-registers
+        self.perf = coll.create(f"osd.{whoami}")
+        self.perf.add("op", description="client ops executed")
+        self.perf.add("op_latency", type=TYPE_AVG,
+                      description="client op latency (seconds)")
+        self.perf.add("subop", description="replication sub-ops applied")
+        self.perf.add("recovery_push",
+                      description="objects pushed by recovery/backfill")
+        self.perf.add("heartbeat_failures",
+                      description="peers reported failed to the mon")
         # op execution substrate: sharded queue (per-PG order, cross-PG
         # concurrency) + finisher for completions + per-op tracking
         self.hb_map = HeartbeatMap()
         self.optracker = OpTracker()
-        self.op_queue = ShardedOpQueue(f"osd.{whoami}.op_tp",
-                                       num_shards=self.NUM_OP_SHARDS,
-                                       hb_map=self.hb_map)
+        self.op_queue = ShardedOpQueue(
+            f"osd.{whoami}.op_tp",
+            num_shards=self.config.get("osd_op_num_shards"),
+            hb_map=self.hb_map)
         self.finisher = Finisher(f"osd.{whoami}.finisher",
                                  hb_map=self.hb_map)
         self.asok: AdminSocket | None = None
         if admin_socket_path:
-            self.asok = AdminSocket(admin_socket_path)
+            self.asok = AdminSocket(admin_socket_path, config=self.config)
             self.asok.register_command(
                 "dump_ops_in_flight",
                 lambda req: self.optracker.dump_ops_in_flight(),
@@ -115,6 +156,11 @@ class OSD(Dispatcher):
         # (the reference requeues at the front for the same reason)
         self._waiting_for_active: dict[PG, list] = {}
         self._op_seq = 0
+        # host-wide recovery throttle: background pushes across ALL PGs
+        # share these slots so backfill cannot monopolize the daemon
+        # (AsyncReserver, src/common/AsyncReserver.h)
+        self.recovery_reservations = asyncio.Semaphore(
+            self.config.get("osd_max_recovery_in_flight"))
         self._booted = asyncio.Event()
         self._hb_task: asyncio.Task | None = None
         self._scrub_task: asyncio.Task | None = None
@@ -188,10 +234,18 @@ class OSD(Dispatcher):
         PG this OSD is primary of (the reference's OSD::sched_scrub);
         every DEEP_SCRUB_EVERY-th round re-reads data (deep)."""
         rounds = 0
+        last = time.monotonic()
         while True:
-            await asyncio.sleep(self.SCRUB_INTERVAL)
+            # sleep in short slices so a runtime `config set
+            # osd_scrub_interval` takes effect without waiting out the
+            # previous interval
+            interval = self.config.get("osd_scrub_interval")
+            await asyncio.sleep(min(1.0, interval / 4))
+            if time.monotonic() - last < interval:
+                continue
+            last = time.monotonic()
             rounds += 1
-            deep = rounds % self.DEEP_SCRUB_EVERY == 0
+            deep = rounds % self.config.get("osd_deep_scrub_every") == 0
             for pg in list(self.pgs.values()):
                 if not (pg.is_primary() and pg.state == "active"):
                     continue
@@ -355,7 +409,7 @@ class OSD(Dispatcher):
 
     async def _heartbeat(self) -> None:
         while True:
-            await asyncio.sleep(self.HB_INTERVAL)
+            await asyncio.sleep(self.config.get("osd_heartbeat_interval"))
             now = time.monotonic()
             for peer in self._hb_peers():
                 if not self.osdmap.is_up(peer):
@@ -363,11 +417,12 @@ class OSD(Dispatcher):
                     self._hb_reported.discard(peer)
                     continue
                 last = self._hb_last.setdefault(peer, now)
-                if now - last > self.HB_GRACE:
+                if now - last > self.config.get("osd_heartbeat_grace"):
                     if peer not in self._hb_reported:
                         self._hb_reported.add(peer)
                         try:
                             await self.monc.report_failure(peer, self.whoami)
+                            self.perf.inc("heartbeat_failures")
                             dout("osd", 2, f"osd.{self.whoami} reported "
                                            f"osd.{peer} down")
                         except Exception:
@@ -401,6 +456,7 @@ class OSD(Dispatcher):
             pg = self._pg_of(msg)
             if pg is not None:
                 await pg.backend.handle_rep_op(conn, msg)
+                self.perf.inc("subop")
             return True
         if isinstance(msg, MOSDRepOpReply):
             pg = self._pg_of(msg)
@@ -427,8 +483,11 @@ class OSD(Dispatcher):
             return True
         if isinstance(msg, MOSDPGInfo):
             pg = self._pg_of(msg, create=True)
-            if pg is not None and msg.payload.get("op") == "activate":
-                pg.handle_activate(msg)
+            if pg is not None:
+                if msg.payload.get("op") == "activate":
+                    pg.handle_activate(msg)
+                elif msg.payload.get("op") == "recovering":
+                    pg.handle_recovering(msg)
             return True
         if isinstance(msg, MOSDRepScrub):
             pg = self._pg_of(msg)
@@ -453,6 +512,8 @@ class OSD(Dispatcher):
             pg = self._pg_of(msg, create=True)
             if pg is not None:
                 await pg.backend.handle_sub_op(conn, msg)
+                if isinstance(msg, MOSDECSubOpWrite):
+                    self.perf.inc("subop")
             return True
         if isinstance(msg, (MOSDECSubOpWriteReply, MOSDECSubOpReadReply)):
             pg = self._pg_of(msg)
@@ -520,11 +581,14 @@ class OSD(Dispatcher):
                 return
             trk.mark_event("dequeued")
             token = set_current_op(trk)
+            t0 = time.monotonic()
             try:
                 await self._handle_op(conn, msg)
             finally:
                 reset_current_op(token)
                 trk.finish()
+                self.perf.inc("op")
+                self.perf.avg_add("op_latency", time.monotonic() - t0)
         self.op_queue.enqueue((pgid.pool, pgid.ps), work)
 
     def requeue_waiting(self, pg: PGInstance) -> None:

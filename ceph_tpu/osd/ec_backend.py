@@ -625,7 +625,11 @@ class ECBackend(PGBackend):
             entry = LogEntry.from_dict(p["entry"])
             if entry.version > self.pg.log.head:
                 self.pg.log.append(entry)
-            self.pg.log.mark_recovered(p["oid"])
+            if p["sub"]["op"] in ("write_full", "delete"):
+                # full-state sub-ops supersede whatever was missing;
+                # an EXTENT write does not restore the base, so a
+                # recovering shard stays in the missing set
+                self.pg.log.mark_recovered(p["oid"])
             self.pg.persist_meta()
             conn.send_message(MOSDECSubOpWriteReply(
                 {"pgid": p["pgid"], "tid": p["tid"],
@@ -731,10 +735,12 @@ class ECBackend(PGBackend):
         chunk, attrs = rec
         await self.pg.send_push(peer, oid, chunk, attrs, delete=False)
 
-    async def pull_object(self, auth_peer: int, oid: str, need) -> None:
+    async def pull_object(self, auth_peer: int, oid: str, need,
+                          fallbacks=()) -> None:
         """We (the primary) lack this object: reconstruct OUR positional
         chunk from the survivors instead of copying the auth peer's (its
-        chunk is a different position)."""
+        chunk is a different position; the gather already consults every
+        live shard, so `fallbacks` is implicit here)."""
         me = self.pg.acting.index(self.host.whoami)
         try:
             rec = await self._reconstruct(oid, me, exclude=frozenset())

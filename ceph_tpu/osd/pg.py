@@ -67,6 +67,12 @@ class PGInstance:
         self._peer_logs: dict[int, dict] = {}
         self._peer_waiters: dict[int, asyncio.Future] = {}
         self._push_waiters: dict[str, asyncio.Future] = {}
+        # async recovery: oid -> behind peers still needing a push;
+        # activation of those peers is deferred until their set drains
+        self._pending_recovery: dict[str, set[int]] = {}
+        self._deferred_activate: dict[int, dict] = {}
+        self._recovery_inflight: dict[str, asyncio.Future] = {}
+        self._recovery_task: asyncio.Task | None = None
         # scrub: (tid, peer) -> future resolving to the peer's scrub map
         self._scrub_waiters: dict[tuple, asyncio.Future] = {}
         self.last_scrub: dict | None = None
@@ -184,6 +190,12 @@ class PGInstance:
         if self._peer_task is not None and not self._peer_task.done():
             self._peer_task.cancel()
         self._peer_task = None
+        if self._recovery_task is not None and \
+                not self._recovery_task.done():
+            self._recovery_task.cancel()
+        self._recovery_task = None
+        self._pending_recovery.clear()
+        self._deferred_activate.clear()
         for fut in self._peer_waiters.values():
             if not fut.done():
                 fut.cancel()
@@ -247,7 +259,7 @@ class PGInstance:
                 auth_osd, auth_head = peer, head
 
         if auth_osd != self.host.whoami:
-            # GetMissing: merge the authoritative log, pull what we lack
+            # GetMissing: merge the authoritative log
             auth = replies[auth_osd]
             auth_entries = [LogEntry.from_dict(e) for e in auth["entries"]]
             auth_tail = tuple(auth["info"]["log_tail"])
@@ -259,23 +271,52 @@ class PGInstance:
                 await self._backfill_from(auth_osd, auth_entries,
                                           auth_head, auth_tail)
             else:
-                missing = self.log.merge_log(auth_entries, auth_head)
+                self.log.merge_log(auth_entries, auth_head)
                 self.seq = max(self.seq, self.log.head[1])
-                for oid, need in missing.items():
-                    if tuple(need) == ZERO:
-                        # rewind-to-none tombstone: the authoritative
-                        # history DELETED this object — reconstructing it
-                        # from surviving shards (or their rollback
-                        # generations) would resurrect an acked delete
-                        # (found by the thrashing model checker)
-                        self.backend.local_apply(oid, "delete", b"")
-                    else:
-                        await self.backend.pull_object(auth_osd, oid, need)
-                self.log.clear_missing()
+        # recover the PRIMARY itself before serving anything: merged
+        # missing plus anything persisted from an earlier interval when
+        # we were a recovering replica (the reference's own-missing set).
+        # When we ARE the auth (recovering replica won the election),
+        # pull from the peer with the highest head — most likely to
+        # still hold the object
+        source = auth_osd
+        if source == self.host.whoami and replies:
+            source = max(replies,
+                         key=lambda p: tuple(
+                             replies[p]["info"]["last_update"]))
+        real_missing = {o: n for o, n in self.log.missing.items()
+                        if tuple(n) != ZERO}
+        if real_missing and source == self.host.whoami:
+            # we are missing acked objects and have NO peer to pull from
+            # (sole survivor): going active would serve ENOENT for them
+            # and clearing the missing set would destroy the only record
+            # — stay in peering until a peer returns or the interval
+            # changes (the reference blocks on unfound objects likewise)
+            raise PeerSilent(
+                f"missing {len(real_missing)} objects with no pull "
+                f"source (sole survivor)")
+        for oid, need in list(self.log.missing.items()):
+            if tuple(need) == ZERO:
+                # rewind-to-none tombstone: the authoritative history
+                # DELETED this object — reconstructing it from surviving
+                # shards (or their rollback generations) would resurrect
+                # an acked delete (found by the thrashing model checker)
+                self.backend.local_apply(oid, "delete", b"")
+            else:
+                await self.backend.pull_object(
+                    source, oid, need,
+                    fallbacks=[p for p in sorted(replies) if p != source])
+        self.log.clear_missing()
 
-        # Activate: bring every replica to the authoritative state
+        # Activate: up-to-date replicas immediately; behind replicas get
+        # a persisted `recovering` marker and their pushes run in the
+        # BACKGROUND (reservation-throttled) so client I/O proceeds
+        # while they backfill (the reference's async recovery/backfill
+        # with AsyncReserver; activation per peer when its data is in)
         log_dict = self.log.to_dict()
         my_objects = None
+        pending: dict[str, set[int]] = {}
+        deferred: dict[int, dict] = {}
         for peer, rep in replies.items():
             peer_head = tuple(rep["info"]["last_update"])
             entries = self.log.entries_since(peer_head)
@@ -289,20 +330,135 @@ class PGInstance:
                 # would otherwise resurrect if it later became primary)
                 if my_objects is None:
                     my_objects = self.list_objects()
-                for oid in my_objects:
-                    await self.backend.push_object(peer, oid)
+                need_oids = list(my_objects)
                 act_payload["objects"] = my_objects
             else:
-                for oid in {e.oid for e in entries}:
-                    await self.backend.push_object(peer, oid)
-            await self.host.send_osd(peer, MOSDPGInfo(act_payload))
+                need_oids = sorted({e.oid for e in entries})
+            if not need_oids:
+                await self.host.send_osd(peer, MOSDPGInfo(act_payload))
+                continue
+            for oid in need_oids:
+                pending.setdefault(oid, set()).add(peer)
+            # only the SHAPE is remembered: the payload is rebuilt from
+            # the live log/object set at activation time — a snapshot
+            # from peering time would rewind the peer's log past writes
+            # replicated to it during background recovery, and its
+            # stale object list would delete legitimately-written
+            # objects as strays
+            deferred[peer] = {"backfill": entries is None}
+            # the peer must KNOW it is missing these objects: if the
+            # primary dies mid-backfill and the peer wins the next
+            # election, its persisted missing set makes it pull them
+            # before going active instead of serving ENOENT
+            await self.host.send_osd(peer, MOSDPGInfo(
+                {"pgid": pgid_key, "op": "recovering", "epoch": epoch,
+                 "from": self.host.whoami,
+                 "missing": {o: list(self.log.head) for o in need_oids}}))
+        self._pending_recovery = pending
+        self._deferred_activate = deferred
         self.last_epoch_started = epoch
         self.persist_meta()
         self.state = "active"
         self._active_event.set()
         self.host.requeue_waiting(self)
         dout("osd", 3, f"osd.{self.host.whoami} pg {self.pgid} active "
-                       f"(acting {self.acting}, head {self.log.head})")
+                       f"(acting {self.acting}, head {self.log.head}, "
+                       f"recovering {len(pending)} objects to "
+                       f"{sorted(deferred)})")
+        if pending:
+            self._recovery_task = asyncio.get_running_loop().create_task(
+                self._drain_recovery())
+
+    # -- async recovery / backfill (primary side) ----------------------------
+
+    async def _drain_recovery(self) -> None:
+        """Push pending objects to behind peers under the host's
+        recovery reservations; activate each peer once its set drains
+        (AsyncReserver semantics, doc/dev/osd_internals/
+        backfill_reservation.rst)."""
+        try:
+            while self._pending_recovery:
+                oid = next(iter(self._pending_recovery))
+                # reservation first (host-wide slot), THEN the op queue's
+                # recovery class: the shard worker must never block on a
+                # slot held by another PG's backfill
+                await self.host.recovery_reservations.acquire()
+                done = asyncio.get_running_loop().create_future()
+
+                async def work(oid=oid, done=done):
+                    try:
+                        await self.recover_object_now(oid)
+                    finally:
+                        self.host.recovery_reservations.release()
+                        if not done.done():
+                            done.set_result(None)
+                self.host.op_queue.enqueue(
+                    (self.pgid.pool, self.pgid.ps), work, klass="recovery")
+                await done
+                if oid in self._pending_recovery:
+                    # push failed and was re-queued: back off instead of
+                    # hammering an unreachable peer
+                    await asyncio.sleep(0.3)
+            await self._activate_recovered()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            dout("osd", 1, f"pg {self.pgid} background recovery failed: "
+                           f"{type(e).__name__} {e} (interval change "
+                           f"will retry)")
+
+    async def recover_object_now(self, oid: str) -> None:
+        """Recover one object to every behind peer NOW — also called by
+        the write path before touching a degraded object (the
+        reference's wait_for_degraded_object). A push already in flight
+        is AWAITED, never raced: the push's reconstruct gathers shard
+        state that a concurrent write could supersede mid-build."""
+        inflight = self._recovery_inflight.get(oid)
+        if inflight is not None:
+            await asyncio.shield(inflight)
+            return
+        peers = self._pending_recovery.pop(oid, None)
+        if not peers:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._recovery_inflight[oid] = fut
+        failed: set[int] = set()
+        try:
+            for peer in sorted(peers):
+                try:
+                    await self.backend.push_object(peer, oid)
+                    self.host.perf.inc("recovery_push")
+                except Exception as e:
+                    dout("osd", 3, f"recovery push of {oid} to osd.{peer} "
+                                   f"failed: {type(e).__name__} {e}")
+                    failed.add(peer)
+        finally:
+            if failed:
+                # a swallowed failure must NOT let the peer activate
+                # with a hole (activation clears its missing record):
+                # keep the oid pending so the drain retries — a truly
+                # dead peer exits via the next interval change
+                self._pending_recovery.setdefault(oid, set()).update(
+                    failed)
+            self._recovery_inflight.pop(oid, None)
+            if not fut.done():
+                fut.set_result(None)
+
+    async def _activate_recovered(self) -> None:
+        deferred, self._deferred_activate = self._deferred_activate, {}
+        log_dict = self.log.to_dict()
+        for peer, shape in deferred.items():
+            act_payload = {"pgid": [self.pgid.pool, self.pgid.ps],
+                           "op": "activate",
+                           "epoch": self.last_epoch_started,
+                           "from": self.host.whoami, "log": log_dict}
+            if shape.get("backfill"):
+                act_payload["objects"] = self.list_objects()
+            try:
+                await self.host.send_osd(peer, MOSDPGInfo(act_payload))
+            except Exception as e:
+                dout("osd", 3, f"deferred activate to osd.{peer} failed: "
+                               f"{type(e).__name__} {e}")
 
     async def _backfill_from(self, auth_osd: int, auth_entries, auth_head,
                              auth_tail) -> None:
@@ -461,9 +617,25 @@ class PGInstance:
         if fut is not None and not fut.done():
             fut.set_result(p["map"])
 
+    def handle_recovering(self, msg: MOSDPGInfo) -> None:
+        """Primary says: you are a recovery/backfill target for these
+        objects. Persisting the missing set means a failover to THIS
+        replica pulls them before going active instead of silently
+        serving ENOENT (pg_missing_t persistence)."""
+        p = msg.payload
+        if p.get("epoch", 0) < self.last_epoch_started:
+            # a delayed marker from a PREVIOUS interval's primary must
+            # not poison a node that has since re-peered with newer data
+            return
+        for oid, need in p.get("missing", {}).items():
+            self.log.missing[oid] = tuple(need)
+        self.persist_meta()
+
     def handle_activate(self, msg: MOSDPGInfo) -> None:
         """Primary says: adopt this log, you are consistent now."""
         p = msg.payload
+        if p.get("epoch", 0) < self.last_epoch_started:
+            return      # stale activation from a superseded interval
         if "objects" in p:
             # backfill activation: anything we hold outside the
             # authoritative set is a stray from before our outage
@@ -602,6 +774,11 @@ class PGInstance:
 
     async def _do_modify_inner(self, kind: str, oid: str, op: dict,
                                data: bytes) -> tuple[int, dict, bytes]:
+        if oid in self._pending_recovery or oid in self._recovery_inflight:
+            # degraded object: an extent write to a peer missing the
+            # base would splice into zeros — recover it everywhere
+            # first (the reference's wait_for_degraded_object)
+            await self.recover_object_now(oid)
         if kind == "create":
             exists = await self.backend.object_exists(oid)
             if exists:

@@ -176,23 +176,41 @@ class Finisher:
 
 
 class ShardedOpQueue:
-    """N FIFO shards drained concurrently; work is routed by key hash so
-    same-key (same-PG) items keep their order (osd_op_tp semantics)."""
+    """N shards drained concurrently; work is routed by key hash so
+    same-key (same-PG) items keep their order (osd_op_tp semantics).
+
+    Each shard holds one FIFO per OP CLASS, drained by weighted round
+    robin — the mClock-lite QoS split (src/osd/scheduler/
+    mClockScheduler.h:92, OpSchedulerItem op classes): client traffic
+    gets `WEIGHTS["client"]` dequeues for every 1 a background class
+    gets, so recovery/backfill can neither starve clients nor be
+    starved by them. FIFO order holds within a class per shard.
+    """
+
+    WEIGHTS = {"client": 4, "recovery": 1, "scrub": 1}
 
     def __init__(self, name: str = "osd_op_tp", num_shards: int = 5,
                  hb_map: HeartbeatMap | None = None,
                  hb_grace: float = 30.0):
         self.name = name
         self.num_shards = num_shards
-        self._queues = [asyncio.Queue() for _ in range(num_shards)]
+        self._queues: list[dict[str, collections.deque]] = [
+            {k: collections.deque() for k in self.WEIGHTS}
+            for _ in range(num_shards)]
+        self._wake = [asyncio.Event() for _ in range(num_shards)]
+        self._credits: list[dict[str, int]] = [
+            dict(self.WEIGHTS) for _ in range(num_shards)]
+        self._stopping = False
         self._tasks: list[asyncio.Task] = []
         self._hb_map = hb_map
         self._hb_grace = hb_grace
         self._hb_ids: list[int] = []
         self.processed = 0
+        self.processed_by_class = collections.Counter()
 
     def start(self) -> None:
         loop = asyncio.get_running_loop()
+        self._stopping = False
         for i in range(self.num_shards):
             if self._hb_map is not None:
                 self._hb_ids.append(self._hb_map.add_worker(
@@ -200,8 +218,9 @@ class ShardedOpQueue:
             self._tasks.append(loop.create_task(self._worker(i)))
 
     async def stop(self) -> None:
-        for q in self._queues:
-            await q.put(None)
+        self._stopping = True
+        for ev in self._wake:
+            ev.set()
         for t in self._tasks:
             try:
                 await t
@@ -215,16 +234,39 @@ class ShardedOpQueue:
     def shard_of(self, key) -> int:
         return hash(key) % self.num_shards
 
-    def enqueue(self, key, work: Callable[[], Awaitable]) -> None:
+    def enqueue(self, key, work: Callable[[], Awaitable],
+                klass: str = "client") -> None:
         """Queue an async thunk on the shard owning `key`."""
-        self._queues[self.shard_of(key)].put_nowait(work)
+        shard = self.shard_of(key)
+        self._queues[shard][klass].append(work)
+        self._wake[shard].set()
+
+    def _pick(self, shard: int) -> Callable | None:
+        """Weighted round robin: spend class credits in weight order;
+        refill when every non-empty class is out of credits."""
+        queues, credits = self._queues[shard], self._credits[shard]
+        for _ in range(2):
+            for klass in self.WEIGHTS:
+                if queues[klass] and credits[klass] > 0:
+                    credits[klass] -= 1
+                    self.processed_by_class[klass] += 1
+                    return queues[klass].popleft()
+            # out of credits for every backlogged class: refill
+            self._credits[shard] = dict(self.WEIGHTS)
+            credits = self._credits[shard]
+        return None
 
     async def _worker(self, shard: int) -> None:
-        q = self._queues[shard]
         while True:
-            work = await q.get()
+            work = self._pick(shard)
             if work is None:
-                return
+                if self._stopping:
+                    return
+                self._wake[shard].clear()
+                if any(self._queues[shard].values()):
+                    continue        # raced a concurrent enqueue
+                await self._wake[shard].wait()
+                continue
             if self._hb_ids:
                 self._hb_map.touch(self._hb_ids[shard])
             try:

@@ -1,0 +1,118 @@
+"""Config + PerfCounters are wired INTO the daemons (r4 verdict #9):
+tunables come from Config and can be changed at runtime through the
+admin socket with observable effect; the OSD emits real perf counters
+served by `perf dump`.
+
+Reference: src/common/config.h:150 (md_config_t observers),
+src/common/perf_counters.h."""
+from __future__ import annotations
+
+import asyncio
+
+from ceph_tpu.utils.admin_socket import admin_command
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+
+
+def test_runtime_config_change_via_admin_socket(tmp_path):
+    """`config set osd_scrub_interval` through the asok makes the
+    background scrub run visibly sooner — the loop re-reads the value
+    (hot reload), and `perf dump` shows the daemon's counters moving."""
+    async def body():
+        from ceph_tpu.osd.daemon import OSD
+        c = ClusterHarness(tmp_path)
+        try:
+            # boot one extra osd manually with an admin socket
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=4, size=3)
+            io = cl.ioctx("rbd")
+            for i in range(12):
+                await io.write_full(f"o{i}", bytes([i]) * 200)
+
+            # target a daemon that is primary of at least one PG (the
+            # scrub scheduler only scrubs primaries)
+            osd0 = next(o for o in c.osds.values()
+                        if any(pg.is_primary() and pg.state == "active"
+                               for pg in o.pgs.values()))
+            sock = str(tmp_path / "osd0.asok")
+            osd0.asok = None
+            from ceph_tpu.utils.admin_socket import AdminSocket
+            asok = AdminSocket(sock, config=osd0.config)
+            asok.register_command(
+                "last_scrub",
+                lambda req: {f"{pgid.pool}.{pgid.ps}": pg.last_scrub
+                             for pgid, pg in osd0.pgs.items()
+                             if pg.last_scrub is not None},
+                "last scrub result per PG")
+            asok.start()
+            try:
+                # defaults: scrub interval 60s — nothing scrubbed yet
+                out = await asyncio.to_thread(
+                    admin_command, sock,
+                    {"prefix": "config get", "key": "osd_scrub_interval"})
+                assert out["result"]["osd_scrub_interval"] == 60.0
+                assert not any(pg.last_scrub
+                               for pg in osd0.pgs.values())
+                # runtime change: scrub every 0.2s
+                out = await asyncio.to_thread(
+                    admin_command, sock,
+                    {"prefix": "config set",
+                     "key": "osd_scrub_interval", "value": 0.2})
+                assert out["result"].get("success")
+                deadline = asyncio.get_running_loop().time() + 10
+                while not any(pg.last_scrub
+                              for pg in osd0.pgs.values()
+                              if pg.is_primary()):
+                    assert asyncio.get_running_loop().time() < deadline, \
+                        "scrub interval change had no effect"
+                    await asyncio.sleep(0.1)
+                # perf dump shows op + subop counters moving
+                dump = await asyncio.to_thread(
+                    admin_command, sock, {"prefix": "perf dump"})
+                me = dump["result"][f"osd.{osd0.whoami}"]
+                total = me["op"] + me["subop"]
+                assert total > 0, me
+                assert me["op_latency"]["avgcount"] == me["op"]
+                # config show lists the schema with effective values
+                out = await asyncio.to_thread(
+                    admin_command, sock, {"prefix": "config show"})
+                assert out["result"]["osd_scrub_interval"] == 0.2
+                assert out["result"]["osd_heartbeat_grace"] == 1.2  # fast_timers
+            finally:
+                asok.stop()
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_heartbeat_tunable_drives_failure_detection(tmp_path):
+    """osd_heartbeat_grace from Config governs mark-down latency: a
+    daemon started with a long grace does not report a dead peer within
+    the window, then a runtime change to a short grace makes the report
+    happen."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=4, size=3)
+            io = cl.ioctx("rbd")
+            await io.write_full("o", b"x")
+            # survivors get a LONG grace at runtime
+            for i, osd in c.osds.items():
+                osd.config.set("osd_heartbeat_grace", 30.0)
+            await c.kill_osd(2)
+            await asyncio.sleep(2.0)
+            maps = [o.osdmap for o in c.osds.values()]
+            assert all(2 not in m.osds or m.osds[2].up for m in maps), \
+                "peer marked down despite 30s grace"
+            # shorten it: failure reported promptly
+            for i, osd in c.osds.items():
+                osd.config.set("osd_heartbeat_grace", 0.6)
+            await c.wait_osd_down(2, timeout=15)
+            assert sum(o.perf.dump()["heartbeat_failures"]
+                       for o in c.osds.values()) >= 1
+        finally:
+            await c.stop()
+    run(body())

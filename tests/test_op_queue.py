@@ -118,3 +118,36 @@ def test_ops_parked_during_peering_complete(tmp_path):
         finally:
             await c.stop()
     run(body())
+
+
+def test_weighted_classes_share_a_shard():
+    """mClock-lite: with both classes backlogged on one shard, client
+    work gets WEIGHTS['client'] dequeues per recovery dequeue — neither
+    class starves (mClockScheduler.h:92 op-class separation)."""
+    async def body():
+        q = ShardedOpQueue(num_shards=1)
+        order: list[str] = []
+
+        async def item(klass):
+            order.append(klass)
+
+        # preload BOTH classes before starting the worker
+        for _ in range(20):
+            q.enqueue("k", lambda: item("c"), klass="client")
+        for _ in range(20):
+            q.enqueue("k", lambda: item("r"), klass="recovery")
+        q.start()
+        deadline = asyncio.get_running_loop().time() + 5
+        while len(order) < 40:
+            assert asyncio.get_running_loop().time() < deadline, order
+            await asyncio.sleep(0.01)
+        await q.stop()
+        w = ShardedOpQueue.WEIGHTS["client"]
+        # while both backlogs are non-empty, the interleave is w:1
+        head = order[:5 * (w + 1)]
+        for i in range(0, len(head), w + 1):
+            block = head[i:i + w + 1]
+            assert block == ["c"] * w + ["r"], (i, head)
+        # recovery finishes its share after clients drain — nothing lost
+        assert order.count("c") == 20 and order.count("r") == 20
+    run(body())
