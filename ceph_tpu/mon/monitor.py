@@ -515,7 +515,8 @@ class Monitor(Dispatcher):
         prefix = cmd.get("prefix", "")
         read_only = prefix in ("mon stat", "osd dump", "osd tree",
                                "osd erasure-code-profile ls",
-                               "osd erasure-code-profile get")
+                               "osd erasure-code-profile get",
+                               "status", "health")
         if not read_only and not (self.paxos.is_leader()
                                   and self.paxos.is_active()):
             conn.send_message(self._retry_ack(tid, "not leader"))
@@ -544,8 +545,67 @@ class Monitor(Dispatcher):
              "leader_addr": (list(self.monmap.addr_of_rank(leader))
                              if leader is not None else None)})
 
+    def _health_checks(self) -> dict:
+        """HEALTH_OK/WARN/ERR with per-check detail (the reference's
+        health_check_map_t, src/mon/health_check.h; checks modeled on
+        OSD_DOWN / OSD_OUT_OF_QUORUM / POOL levels)."""
+        om = self.osdmon
+        checks: dict[str, dict] = {}
+        down = [i for i, st in om.osdmap.osds.items() if not st.up]
+        if down:
+            checks["OSD_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(down)} osds down",
+                "detail": [f"osd.{i} is down" for i in sorted(down)]}
+        out = [i for i, st in om.osdmap.osds.items()
+               if getattr(st, "out", False)]
+        if out:
+            checks["OSD_OUT"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(out)} osds out",
+                "detail": [f"osd.{i} is out" for i in sorted(out)]}
+        quorum = sorted(self.paxos.quorum)
+        if len(quorum) <= len(self.monmap.mons) // 2:
+            checks["MON_QUORUM"] = {
+                "severity": "HEALTH_ERR",
+                "summary": f"quorum {quorum} of "
+                           f"{len(self.monmap.mons)} monitors"}
+        for pool in om.osdmap.pools.values():
+            up_osds = sum(1 for st in om.osdmap.osds.values() if st.up)
+            if up_osds < pool.min_size:
+                checks.setdefault("POOL_UNAVAILABLE", {
+                    "severity": "HEALTH_ERR",
+                    "summary": "pools below min_size",
+                    "detail": []})["detail"].append(
+                    f"pool {pool.name!r} needs {pool.min_size} "
+                    f"up osds, have {up_osds}")
+        if any(c["severity"] == "HEALTH_ERR" for c in checks.values()):
+            status = "HEALTH_ERR"
+        elif checks:
+            status = "HEALTH_WARN"
+        else:
+            status = "HEALTH_OK"
+        return {"status": status, "checks": checks}
+
     async def _run_command(self, prefix: str, cmd: dict) -> dict:
         om = self.osdmon
+        if prefix == "health":
+            return self._health_checks()
+        if prefix == "status":
+            # `ceph -s` analog: health + mon + osd + pool summary
+            up = sum(1 for st in om.osdmap.osds.values() if st.up)
+            return {
+                "health": self._health_checks(),
+                "monmap": {"mons": sorted(self.monmap.mons),
+                           "quorum": sorted(self.paxos.quorum),
+                           "leader": self.paxos.leader},
+                "osdmap": {"epoch": om.osdmap.epoch,
+                           "num_osds": len(om.osdmap.osds),
+                           "num_up_osds": up},
+                "pools": {p.name: {"type": p.type, "size": p.size,
+                                   "pg_num": p.pg_num}
+                          for p in om.osdmap.pools.values()},
+            }
         if prefix == "mon stat":
             return {"name": self.name, "rank": self.rank,
                     "leader": self.paxos.leader,

@@ -1,0 +1,114 @@
+"""`rados`-style CLI against a running cluster.
+
+Re-creation of the reference tool surface (src/tools/rados/rados.cc:124
+usage: put/get/ls/rm/stat/bench; plus the `ceph status|health` mon
+plane from src/ceph.in) over the librados subset.
+
+Usage:
+    python -m ceph_tpu.tools.rados_cli -m 127.0.0.1:PORT [-p POOL] CMD...
+
+Commands:
+    ls                      list objects in the pool
+    put OBJ FILE            write FILE (or - for stdin) to OBJ
+    get OBJ FILE            read OBJ into FILE (or - for stdout)
+    rm OBJ                  delete OBJ
+    stat OBJ                object size
+    bench SECONDS write     throughput bench (obj_bencher analog)
+    lspools                 pool names
+    mkpool NAME [SIZE]      create a replicated pool
+    status                  cluster status (ceph -s)
+    health                  health checks (ceph health)
+    df                      per-pool object counts
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ceph_tpu.rados import RadosClient
+
+
+async def _run(args) -> int:
+    host, port = args.mon.rsplit(":", 1)
+    client = RadosClient([(host, int(port))])
+    await client.connect()
+    try:
+        cmd = args.cmd[0]
+        if cmd == "status":
+            print(json.dumps(await client.command({"prefix": "status"}),
+                             indent=1))
+        elif cmd == "health":
+            out = await client.command({"prefix": "health"})
+            print(out["status"])
+            for name, chk in out.get("checks", {}).items():
+                print(f"  [{chk['severity']}] {name}: {chk['summary']}")
+                for d in chk.get("detail", []):
+                    print(f"      {d}")
+        elif cmd == "lspools":
+            for name in sorted(client.osdmap.pool_names):
+                print(name)
+        elif cmd == "mkpool":
+            name = args.cmd[1]
+            size = int(args.cmd[2]) if len(args.cmd) > 2 else 3
+            out = await client.pool_create(name, pg_num=8, size=size)
+            print(json.dumps(out))
+        elif cmd == "df":
+            for name in sorted(client.osdmap.pool_names):
+                objs = await client.ioctx(name).list_objects()
+                print(f"{name}\t{len(objs)} objects")
+        else:
+            if not args.pool:
+                print("error: -p POOL required", file=sys.stderr)
+                return 2
+            io = client.ioctx(args.pool)
+            if cmd == "ls":
+                for oid in await io.list_objects():
+                    print(oid)
+            elif cmd == "put":
+                oid, path = args.cmd[1], args.cmd[2]
+                data = sys.stdin.buffer.read() if path == "-" else \
+                    open(path, "rb").read()
+                await io.write_full(oid, data)
+                print(f"wrote {len(data)} bytes to {oid}")
+            elif cmd == "get":
+                oid, path = args.cmd[1], args.cmd[2]
+                data = await io.read(oid)
+                if path == "-":
+                    sys.stdout.buffer.write(data)
+                else:
+                    open(path, "wb").write(data)
+                    print(f"read {len(data)} bytes from {oid}")
+            elif cmd == "rm":
+                await io.remove(args.cmd[1])
+            elif cmd == "stat":
+                st = await io.stat(args.cmd[1])
+                print(f"{args.pool}/{args.cmd[1]} size {st['size']}")
+            elif cmd == "bench":
+                from ceph_tpu.tools.rados_bench import run_bench
+                out = await run_bench(io, seconds=float(args.cmd[1]),
+                                      concurrency=args.concurrency,
+                                      object_size=args.object_size)
+                print(json.dumps(out, indent=1))
+            else:
+                print(f"unknown command {cmd!r}", file=sys.stderr)
+                return 2
+        return 0
+    finally:
+        await client.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rados")
+    ap.add_argument("-m", "--mon", required=True,
+                    help="monitor address host:port")
+    ap.add_argument("-p", "--pool", default=None)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--object-size", type=int, default=65536)
+    ap.add_argument("cmd", nargs="+")
+    return asyncio.run(_run(ap.parse_args(argv)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

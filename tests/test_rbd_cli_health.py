@@ -1,0 +1,141 @@
+"""Breadth slice: RBD-lite block images, the rados CLI surface, and the
+mon health/status plane (r4 verdict missing #8/#10)."""
+from __future__ import annotations
+
+import asyncio
+import os
+
+import pytest
+
+from ceph_tpu.rbd import RBD, Image, ImageNotFound
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+
+
+@pytest.mark.parametrize("pool_type", ["replicated", "erasure"])
+def test_rbd_image_end_to_end(tmp_path, pool_type):
+    """Create/open/write/read/resize/discard a striped image — on
+    replicated AND EC (RMW overwrites) data pools."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            if pool_type == "erasure":
+                await cl.command({"prefix": "osd erasure-code-profile set",
+                                  "name": "prof",
+                                  "profile": {"plugin": "jerasure",
+                                              "k": "2", "m": "1"}})
+                await cl.pool_create("rbd", pg_num=4, pool_type="erasure",
+                                     erasure_code_profile="prof")
+            else:
+                await cl.pool_create("rbd", pg_num=4, size=3)
+            io = cl.ioctx("rbd")
+            size = 300 * 1024
+            await RBD.create(io, "img", size, order=16)   # 64 KiB objects
+            assert await RBD.list(io) == ["img"]
+            with pytest.raises(Exception):
+                await RBD.create(io, "img", size)         # EEXIST
+
+            img = await Image.open(io, "img")
+            assert (await img.stat())["object_size"] == 65536
+            # sparse: untouched image reads zeros
+            assert await img.read(0, 100) == b"\0" * 100
+            # cross-object writes at unaligned offsets
+            blob = os.urandom(150 * 1024)
+            await img.write(60 * 1024, blob)
+            assert await img.read(60 * 1024, len(blob)) == blob
+            # surrounding bytes stay zero
+            assert await img.read(0, 60 * 1024) == b"\0" * (60 * 1024)
+            # read clamps at image size
+            tail = await img.read(size - 10, 1000)
+            assert len(tail) == 10
+            with pytest.raises(Exception):
+                await img.write(size - 5, b"0123456789")  # past the end
+
+            # discard re-sparsifies whole objects and zeroes edges
+            await img.discard(64 * 1024, 64 * 1024)
+            assert await img.read(64 * 1024, 64 * 1024) == b"\0" * 65536
+            data_objs = [o for o in await io.list_objects()
+                         if o.startswith("rbd_data.img")]
+            assert f"rbd_data.img.{1:016x}" not in data_objs
+
+            # shrink then grow: the reclaimed range reads zeros
+            await img.resize(100 * 1024)
+            assert img.size == 100 * 1024
+            await img.resize(200 * 1024)
+            assert await img.read(100 * 1024, 1024) == b"\0" * 1024
+            # header change is durable across open
+            img2 = await Image.open(io, "img")
+            assert img2.size == 200 * 1024
+
+            await RBD.remove(io, "img")
+            assert await RBD.list(io) == []
+            with pytest.raises(ImageNotFound):
+                await Image.open(io, "img")
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_health_and_status_commands(tmp_path):
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=4, size=3)
+            health = await cl.command({"prefix": "health"})
+            assert health["status"] == "HEALTH_OK", health
+            st = await cl.command({"prefix": "status"})
+            assert st["osdmap"]["num_up_osds"] == 3
+            assert st["pools"]["rbd"]["size"] == 3
+            # kill an osd: health degrades with a named check
+            await c.kill_osd(2)
+            await c.wait_osd_down(2)
+            health = await cl.command({"prefix": "health"})
+            assert health["status"] == "HEALTH_WARN", health
+            assert "OSD_DOWN" in health["checks"]
+            assert "osd.2 is down" in \
+                health["checks"]["OSD_DOWN"]["detail"]
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_rados_cli_round_trip(tmp_path):
+    """Drive the CLI main() against a live cluster: mkpool, put, ls,
+    stat, get, rm, health."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            mon = c.mon_addrs[0]
+            maddr = f"{mon[0]}:{mon[1]}"
+            from ceph_tpu.tools.rados_cli import main as cli
+            src = tmp_path / "payload.bin"
+            dst = tmp_path / "out.bin"
+            src.write_bytes(os.urandom(10000))
+
+            def run_cli(*argv):
+                return cli(["-m", maddr, *argv])
+
+            assert await asyncio.to_thread(
+                run_cli, "mkpool", "cli-pool", "3") == 0
+            assert await asyncio.to_thread(
+                run_cli, "-p", "cli-pool", "put", "obj1", str(src)) == 0
+            assert await asyncio.to_thread(
+                run_cli, "-p", "cli-pool", "ls") == 0
+            assert await asyncio.to_thread(
+                run_cli, "-p", "cli-pool", "stat", "obj1") == 0
+            assert await asyncio.to_thread(
+                run_cli, "-p", "cli-pool", "get", "obj1", str(dst)) == 0
+            assert dst.read_bytes() == src.read_bytes()
+            assert await asyncio.to_thread(run_cli, "health") == 0
+            assert await asyncio.to_thread(run_cli, "status") == 0
+            assert await asyncio.to_thread(
+                run_cli, "-p", "cli-pool", "rm", "obj1") == 0
+            assert await asyncio.to_thread(run_cli, "df") == 0
+        finally:
+            await c.stop()
+    run(body())
