@@ -100,6 +100,11 @@ def main() -> int:
     cpu = run_stage("cpu", _hermetic_env(), _budget(CPU_TIMEOUT))
     stages["cpu"] = cpu
 
+    # Stage 1b: in-situ cluster throughput (rados-bench analog) —
+    # hermetic CPU, measures the framework end to end.
+    cluster = run_stage("cluster", _hermetic_env(), _budget(240))
+    stages["cluster"] = cluster
+
     # Stage 2: ONE long-warm device child — backend init and benches in
     # the same process so the (potentially minutes-long) axon warm is
     # never discarded. Falls back to hermetic cpu-jax only if the warmed
@@ -116,6 +121,8 @@ def main() -> int:
 
     detail = {k: v for k, v in cpu.items()
               if k not in ("status", "elapsed_s", "stderr_tail")}
+    detail.update({k: v for k, v in cluster.items()
+                   if k not in ("status", "elapsed_s", "stderr_tail")})
     detail.update({k: v for k, v in device.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})
 

@@ -1,0 +1,10 @@
+"""Mgr-lite: the monitoring/metrics plane.
+
+The reference mgr daemon's most-load-bearing module is the prometheus
+exporter (src/pybind/mgr/prometheus/module.py); this package provides
+its analog: an HTTP endpoint exposing every PerfCounters metric in the
+process plus cluster health, in the prometheus text format.
+"""
+from ceph_tpu.mgr.exporter import MetricsExporter
+
+__all__ = ["MetricsExporter"]

@@ -1,0 +1,122 @@
+"""Prometheus-format metrics endpoint (mgr prometheus module analog).
+
+Re-creation of the reference exporter's surface
+(src/pybind/mgr/prometheus/module.py: GET /metrics, text format 0.0.4;
+src/exporter/ for the per-daemon variant): every PerfCounters instance
+in the process is exported as `ceph_<counter>{daemon="..."} value`;
+avg counters split into _sum/_count like prometheus summaries; an
+optional health callback adds `ceph_health_status` (0=OK 1=WARN 2=ERR)
+and per-check gauges. GET /health returns the raw health JSON.
+
+HTTP/1.0 server on asyncio — no external dependencies.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Awaitable, Callable
+
+from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.perf_counters import PerfCountersCollection
+
+_SEVERITY = {"HEALTH_OK": 0, "HEALTH_WARN": 1, "HEALTH_ERR": 2}
+
+
+def _sanitize(name: str) -> str:
+    return "".join(ch if ch.isalnum() or ch == "_" else "_"
+                   for ch in name)
+
+
+def render_metrics(health: dict | None = None) -> str:
+    """The /metrics payload: every registered counter, text format."""
+    out: list[str] = []
+    dump = PerfCountersCollection.instance().dump()
+    seen_types: set[str] = set()
+    for daemon, counters in sorted(dump.items()):
+        label = f'daemon="{daemon}"'
+        for key, value in sorted(counters.items()):
+            metric = f"ceph_{_sanitize(key)}"
+            if isinstance(value, dict) and "avgcount" in value:
+                for suffix, v in (("_sum", value.get("sum", 0.0)),
+                                  ("_count", value["avgcount"])):
+                    out.append(f"{metric}{suffix}{{{label}}} {v}")
+                continue
+            if isinstance(value, dict):        # histogram: export buckets
+                for bucket, count in value.get("buckets", {}).items():
+                    out.append(
+                        f'{metric}_bucket{{{label},le="{bucket}"}} '
+                        f"{count}")
+                continue
+            if metric not in seen_types:
+                out.append(f"# TYPE {metric} counter")
+                seen_types.add(metric)
+            out.append(f"{metric}{{{label}}} {value}")
+    if health is not None:
+        out.append("# TYPE ceph_health_status gauge")
+        out.append(f"ceph_health_status "
+                   f"{_SEVERITY.get(health.get('status'), 2)}")
+        for name, chk in health.get("checks", {}).items():
+            out.append(f'ceph_health_detail{{check="{_sanitize(name)}",'
+                       f'severity="{chk.get("severity")}"}} 1')
+    return "\n".join(out) + "\n"
+
+
+class MetricsExporter:
+    """Serve /metrics (prometheus text) and /health (JSON)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 health_cb: Callable[[], Awaitable[dict]] | None = None):
+        self.host, self.port = host, port
+        self.health_cb = health_cb
+        self._server: asyncio.Server | None = None
+        self.addr: tuple[str, int] | None = None
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+        dout("mgr", 1, f"metrics exporter on {self.addr}")
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), 10.0)
+            parts = request.decode(errors="replace").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            while True:        # drain headers
+                line = await asyncio.wait_for(reader.readline(), 10.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            health = None
+            if self.health_cb is not None:
+                try:
+                    health = await self.health_cb()
+                except Exception as e:
+                    dout("mgr", 2, f"health callback failed: {e}")
+            if path.startswith("/metrics"):
+                body = render_metrics(health).encode()
+                ctype = "text/plain; version=0.0.4"
+                code = "200 OK"
+            elif path.startswith("/health"):
+                body = json.dumps(health or {}).encode()
+                ctype = "application/json"
+                code = "200 OK"
+            else:
+                body = b"try /metrics or /health\n"
+                ctype = "text/plain"
+                code = "404 Not Found"
+            writer.write(
+                f"HTTP/1.0 {code}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+            await writer.drain()
+        except (asyncio.TimeoutError, OSError):
+            pass
+        finally:
+            writer.close()

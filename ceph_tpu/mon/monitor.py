@@ -308,12 +308,13 @@ class Monitor(Dispatcher):
     """One monitor daemon: messenger + paxos + services + client plane."""
 
     def __init__(self, name: str, monmap: MonMap,
-                 store_path: str | None = None):
+                 store_path: str | None = None,
+                 auth_key: bytes | None = None):
         self.name = name
         self.monmap = monmap
         self.rank = monmap.rank_of(name)
         self.store = MonStore(store_path)
-        self.messenger = Messenger(f"mon.{name}")
+        self.messenger = Messenger(f"mon.{name}", auth_key=auth_key)
         self.messenger.add_dispatcher(self)
         peers = {monmap.rank_of(n): addr for n, addr in monmap.mons.items()
                  if n != name}
@@ -558,7 +559,7 @@ class Monitor(Dispatcher):
                 "summary": f"{len(down)} osds down",
                 "detail": [f"osd.{i} is down" for i in sorted(down)]}
         out = [i for i, st in om.osdmap.osds.items()
-               if getattr(st, "out", False)]
+               if not getattr(st, "in_cluster", True)]
         if out:
             checks["OSD_OUT"] = {
                 "severity": "HEALTH_WARN",
@@ -570,8 +571,11 @@ class Monitor(Dispatcher):
                 "severity": "HEALTH_ERR",
                 "summary": f"quorum {quorum} of "
                            f"{len(self.monmap.mons)} monitors"}
+        # global up-count vs per-pool min_size: a coarse availability
+        # check (placement-level starvation is a pg-state concern the
+        # mon does not track here)
+        up_osds = sum(1 for st in om.osdmap.osds.values() if st.up)
         for pool in om.osdmap.pools.values():
-            up_osds = sum(1 for st in om.osdmap.osds.values() if st.up)
             if up_osds < pool.min_size:
                 checks.setdefault("POOL_UNAVAILABLE", {
                     "severity": "HEALTH_ERR",

@@ -41,6 +41,7 @@ class Tag(enum.IntEnum):
     RECONNECT = 2
     RECONNECT_OK = 3
     RESET = 4
+    AUTH = 5            # initiator's auth proof (cephx-lite 3rd leg)
     ACK = 8
     KEEPALIVE = 9
     KEEPALIVE_ACK = 10

@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import hashlib
+import hmac
 import json
 import os
 import time
@@ -31,6 +33,13 @@ from typing import Awaitable, Callable
 from ceph_tpu.msg.frames import BANNER, Frame, FrameError, Tag
 from ceph_tpu.msg.messages import Message
 from ceph_tpu.utils.dout import dout
+
+
+def _auth_proof(key: bytes, role: str, nonce_a: str, nonce_b: str) -> str:
+    """cephx-lite challenge proof: HMAC-SHA256 over both nonces with a
+    role prefix so the two legs can never be reflected at each other."""
+    return hmac.new(key, f"{role}|{nonce_a}|{nonce_b}".encode(),
+                    hashlib.sha256).hexdigest()
 
 
 class Policy:
@@ -192,6 +201,10 @@ class Connection:
             "reconnect": reconnect,
             "lossy": self.policy.lossy,
         }
+        my_nonce = None
+        if self.messenger.auth_key is not None:
+            my_nonce = os.urandom(16).hex()
+            hello["auth_nonce"] = my_nonce
         writer.write(Frame(Tag.RECONNECT if reconnect else Tag.HELLO,
                            [json.dumps(hello).encode()]).encode())
         await writer.drain()
@@ -228,6 +241,20 @@ class Connection:
             return
         if reply.tag in (Tag.HELLO, Tag.RECONNECT_OK):
             info = json.loads(reply.segments[0])
+            if self.messenger.auth_key is not None:
+                # cephx-lite leg 2: verify the acceptor's proof, then
+                # send ours — BEFORE any message flows
+                proof = _auth_proof(self.messenger.auth_key, "srv",
+                                    my_nonce, info.get("auth_nonce", ""))
+                if info.get("auth_proof") != proof:
+                    raise FrameError("auth failed: acceptor proof "
+                                     "missing or wrong (key mismatch?)")
+                writer.write(Frame(Tag.AUTH, [json.dumps(
+                    {"auth_proof": _auth_proof(
+                        self.messenger.auth_key, "cli",
+                        info.get("auth_nonce", ""), my_nonce)}
+                ).encode()]).encode())
+                await writer.drain()
             self.peer_name = info.get("entity", "")
             self._requeue_for_replay(info.get("in_seq", 0))
             self._attach(reader, writer)
@@ -426,8 +453,14 @@ class Messenger:
                       conn = await m.connect(addr, Policy.lossy_client())
     """
 
-    def __init__(self, entity_name: str):
+    def __init__(self, entity_name: str, auth_key: bytes | None = None):
         self.entity_name = entity_name
+        # cephx-lite: a shared cluster secret. When set, every session
+        # (in AND out) must pass mutual HMAC challenge-response before
+        # any message is exchanged (the reference's cephx mutual auth
+        # collapsed onto one service key; divergence: no per-message
+        # signing or on-wire encryption — crc mode only)
+        self.auth_key = auth_key
         self.dispatchers: list[Dispatcher] = []
         self._server: asyncio.base_events.Server | None = None
         self.my_addr: tuple[str, int] | None = None
@@ -466,6 +499,43 @@ class Messenger:
         key = (info.get("entity", "?"), info.get("cookie", 0))
         peer_in_seq = info.get("in_seq", 0)
 
+        def _auth_fields(reply: dict) -> tuple[bool, str | None]:
+            """cephx-lite acceptor: add our nonce+proof to the outgoing
+            reply; returns (ok, expected initiator proof). The expected
+            proof NEVER enters the wire-bound dict."""
+            if self.auth_key is None:
+                return True, None
+            peer_nonce = info.get("auth_nonce")
+            if not peer_nonce:
+                dout("ms", 1, f"{self.entity_name}: rejecting "
+                              f"unauthenticated peer {key[0]}")
+                writer.close()
+                return False, None
+            my_nonce = os.urandom(16).hex()
+            reply["auth_nonce"] = my_nonce
+            reply["auth_proof"] = _auth_proof(self.auth_key, "srv",
+                                              peer_nonce, my_nonce)
+            return True, _auth_proof(self.auth_key, "cli", my_nonce,
+                                     peer_nonce)
+
+        async def _auth_verify(want: str | None) -> bool:
+            if want is None:
+                return True
+            try:
+                proof_frame = await asyncio.wait_for(Frame.read(reader),
+                                                     10.0)
+                got = json.loads(proof_frame.segments[0])
+            except Exception:
+                writer.close()
+                return False
+            if proof_frame.tag != Tag.AUTH or \
+                    got.get("auth_proof") != want:
+                dout("ms", 1, f"{self.entity_name}: peer {key[0]} failed "
+                              f"auth proof")
+                writer.close()
+                return False
+            return True
+
         if frame.tag == Tag.RECONNECT:
             conn = self._sessions.get(key)
             if conn is None or conn._closed:
@@ -474,12 +544,21 @@ class Messenger:
                 await writer.drain()
                 writer.close()
                 return
-            await conn._close_transport()
+            # the FULL auth exchange runs on the new socket BEFORE the
+            # live session's transport is touched: a keyless peer
+            # replaying a sniffed (entity, cookie) must not be able to
+            # kill an authenticated session's transport
             reply = {"entity": self.entity_name,
                      "in_seq": conn._processed_seq}
+            ok, expect = _auth_fields(reply)
+            if not ok:
+                return
             writer.write(Frame(Tag.RECONNECT_OK,
                                [json.dumps(reply).encode()]).encode())
             await writer.drain()
+            if not await _auth_verify(expect):
+                return
+            await conn._close_transport()
             conn._requeue_for_replay(peer_in_seq)
             conn._attach(reader, writer)
             return
@@ -489,8 +568,13 @@ class Messenger:
         conn.peer_name = info["entity"]
         conn.cookie = info.get("cookie", 0)
         reply = {"entity": self.entity_name, "in_seq": 0}
+        ok, expect = _auth_fields(reply)
+        if not ok:
+            return
         writer.write(Frame(Tag.HELLO, [json.dumps(reply).encode()]).encode())
         await writer.drain()
+        if not await _auth_verify(expect):
+            return
         conn._attach(reader, writer)
         if not policy.lossy:
             # one lossless session per peer entity: a fresh HELLO from an

@@ -57,7 +57,8 @@ class OSD(Dispatcher):
     def __init__(self, whoami: int, mon_addrs: list[tuple[str, int]],
                  store=None, crush_location: dict | None = None,
                  admin_socket_path: str | None = None,
-                 config: Config | None = None):
+                 config: Config | None = None,
+                 auth_key: bytes | None = None):
         self.whoami = whoami
         self.store = store if store is not None else MemStore(f"osd{whoami}")
         self.crush_location = crush_location or {"host": f"host{whoami}"}
@@ -139,7 +140,7 @@ class OSD(Dispatcher):
                     "hb_healthy": self.hb_map.is_healthy()[0],
                     "ops_processed": self.op_queue.processed},
                 "daemon status")
-        self.messenger = Messenger(f"osd.{whoami}")
+        self.messenger = Messenger(f"osd.{whoami}", auth_key=auth_key)
         self.messenger.add_dispatcher(self)
         self.monc = MonClient(self.messenger, mon_addrs)
         self.monc.on_osdmap = self._on_osdmap

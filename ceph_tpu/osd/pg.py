@@ -734,9 +734,73 @@ class PGInstance:
                 return self._store_rc(e), {"error": str(e)}, b""
             return 0, {"omap": {k: v.decode("latin1")
                                 for k, v in omap.items()}}, b""
+        if kind == "call":
+            return await self._do_call(oid, op, data)
         if kind == "list":
             return 0, {"objects": self.list_objects()}, b""
         return -22, {"error": f"unknown op {kind!r}"}, b""
+
+    async def _do_call(self, oid: str, op: dict,
+                       data: bytes) -> tuple[int, dict, bytes]:
+        """CEPH_OSD_OP_CALL: run a registered object-class method on the
+        primary; its staged mutations apply atomically through the
+        normal modify path (PrimaryLogPG do_osd_ops CALL dispatch ->
+        ClassHandler)."""
+        from ceph_tpu.cls import ClassCallError, ClassHandler, MethodContext
+        from ceph_tpu.cls.registry import CLS_METHOD_WR
+        if op.get("reqid"):
+            # a retried CALL whose first execution committed must not
+            # re-run the method against post-commit state: its first
+            # staged mutation always carries sub-reqid [.., 100]
+            done_ver = self.log.lookup_reqid((*op["reqid"], 100))
+            if done_ver is not None:
+                return 0, {"version": list(done_ver), "dup": True}, b""
+        try:
+            m = ClassHandler.resolve(op.get("cls", ""), op.get("method", ""))
+        except ClassCallError as e:
+            return e.rc, {"error": str(e)}, b""
+        ctx = MethodContext(self, oid)
+        try:
+            out = await m.fn(ctx, data)
+        except ClassCallError as e:
+            return e.rc, {"error": str(e)}, b""
+        if not ctx.has_writes:
+            return 0, {}, out or b""
+        if not (m.flags & CLS_METHOD_WR):
+            return -1, {"error": "EPERM: read-only method staged writes"}, \
+                b""
+        if self.pool.type == "erasure" and (ctx._staged_xattrs
+                                            or ctx._staged_omap):
+            return -95, {"error": "EOPNOTSUPP: xattr/omap on ec pool"}, b""
+        sub = [0]
+
+        async def apply(kind2: str, extra: dict, data2: bytes) -> dict:
+            o = {"oid": oid, **extra}
+            if op.get("reqid"):
+                # distinct dup-index key per staged sub-mutation
+                o["reqid"] = [*op["reqid"], 100 + sub[0]]
+            sub[0] += 1
+            rc2, out2, _ = await self._do_modify(kind2, oid, o, data2)
+            if rc2 < 0:
+                raise ClassCallError(rc2, str(out2))
+            return out2
+        try:
+            last = {}
+            if ctx.staged is not None:
+                if ctx.staged[0] == "delete":
+                    last = await apply("delete", {}, b"")
+                else:
+                    last = await apply("write_full", {}, ctx.staged[1])
+            for name, value in ctx._staged_xattrs.items():
+                last = await apply("setxattr", {"name": name}, value)
+            if ctx._staged_omap:
+                last = await apply(
+                    "omap_set",
+                    {"kv": {k: v.decode("latin1")
+                            for k, v in ctx._staged_omap.items()}}, b"")
+        except ClassCallError as e:
+            return e.rc, {"error": str(e)}, b""
+        return 0, last, out or b""
 
     @staticmethod
     def _store_rc(e: StoreError) -> int:

@@ -44,8 +44,9 @@ class RadosClient(Dispatcher):
     OP_TIMEOUT = 15.0
     ATTEMPT_TIMEOUT = 5.0
 
-    def __init__(self, mon_addrs: list[tuple[str, int]]):
-        self.messenger = Messenger("client")
+    def __init__(self, mon_addrs: list[tuple[str, int]],
+                 auth_key: bytes | None = None):
+        self.messenger = Messenger("client", auth_key=auth_key)
         self.messenger.add_dispatcher(self)
         self.monc = MonClient(self.messenger, mon_addrs)
         self.monc.on_osdmap = self._on_osdmap
@@ -328,6 +329,16 @@ class IoCtx:
             self.pool_name, oid,
             [{"op": "omap_rm", "oid": oid, "keys": keys}])
         return p
+
+    async def call(self, oid: str, cls: str, method: str,
+                   indata: bytes = b"") -> bytes:
+        """Execute an object-class method server-side
+        (rados_exec / CEPH_OSD_OP_CALL)."""
+        _, out = await self.client.submit(
+            self.pool_name, oid,
+            [{"op": "call", "oid": oid, "cls": cls, "method": method}],
+            indata)
+        return out
 
     async def list_objects(self) -> list[str]:
         """Union of object listings across this pool's PG primaries."""

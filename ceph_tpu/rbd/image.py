@@ -39,17 +39,20 @@ class RBD:
             raise ValueError(f"order {order} out of range 12..26")
         hdr = {"name": name, "size": int(size), "order": order,
                "object_prefix": f"rbd_data.{name}"}
+        oid = _header_oid(name)
         try:
+            # one message, two ops: exclusive create + header write run
+            # back to back on the primary, so a lost client cannot leave
+            # an empty header bricking the name
             await ioctx.client.submit(
-                ioctx.pool_name, _header_oid(name),
-                [{"op": "create", "oid": _header_oid(name),
-                  "exclusive": True}])
+                ioctx.pool_name, oid,
+                [{"op": "create", "oid": oid, "exclusive": True},
+                 {"op": "write_full", "oid": oid}],
+                json.dumps(hdr).encode())
         except RadosError as e:
             if e.rc == -17:
                 raise RadosError(-17, f"image {name!r} exists") from None
             raise
-        await ioctx.write_full(_header_oid(name),
-                               json.dumps(hdr).encode())
 
     @staticmethod
     async def list(ioctx: IoCtx) -> list[str]:
@@ -93,6 +96,10 @@ class Image:
             raw = await ioctx.read(_header_oid(name))
         except ObjectNotFound:
             raise ImageNotFound(name) from None
+        if not raw:
+            # torn create (header object without content): treat as
+            # absent so the name can be re-created or removed
+            raise ImageNotFound(name)
         return cls(ioctx, json.loads(raw))
 
     def _data_oid(self, index: int) -> str:
@@ -135,6 +142,20 @@ class Image:
                                    data[rel:rel + n], offset=ooff)
         return len(data)
 
+    async def _zero_stored(self, idx: int, ooff: int, n: int) -> None:
+        """Zero [ooff, ooff+n) of a data object WITHOUT allocating: an
+        absent object already reads as zeros, and stored bytes past its
+        end do too, so only the overlap with the stored extent is
+        rewritten."""
+        try:
+            stored = (await self.ioctx.stat(self._data_oid(idx)))["size"]
+        except ObjectNotFound:
+            return
+        n = min(n, stored - ooff)
+        if n > 0:
+            await self.ioctx.write(self._data_oid(idx), b"\0" * n,
+                                   offset=ooff)
+
     async def discard(self, offset: int, length: int) -> None:
         """Deallocate: whole covered objects are removed (sparse again),
         partial edges are zero-filled."""
@@ -145,11 +166,7 @@ class Image:
                 except ObjectNotFound:
                     pass
             else:
-                try:
-                    await self.ioctx.write(self._data_oid(idx),
-                                           b"\0" * n, offset=ooff)
-                except ObjectNotFound:
-                    pass
+                await self._zero_stored(idx, ooff, n)
 
     async def resize(self, new_size: int) -> None:
         async with self._hdr_lock:
@@ -166,14 +183,8 @@ class Image:
                 # zero the shrunk tail inside the boundary object so a
                 # later resize-up reads zeros there, not stale bytes
                 if new_size % S:
-                    idx = new_size // S
-                    try:
-                        await self.ioctx.write(
-                            self._data_oid(idx),
-                            b"\0" * (S - new_size % S),
-                            offset=new_size % S)
-                    except ObjectNotFound:
-                        pass
+                    await self._zero_stored(new_size // S, new_size % S,
+                                            S - new_size % S)
             self.size = int(new_size)
             hdr = {"name": self.name, "size": self.size,
                    "order": self.order,

@@ -250,3 +250,61 @@ def test_reconnect_to_restarted_peer_gets_session_reset():
         await client.shutdown()
         await server2.shutdown()
     run(main())
+
+
+# -- cephx-lite auth ---------------------------------------------------------
+
+def test_auth_mutual_handshake_and_rejection():
+    """cephx-lite: same-key peers authenticate mutually; a wrong-key or
+    keyless peer is rejected before any message flows (src/auth/cephx/
+    mutual auth; AuthRegistry negotiation)."""
+    import asyncio
+    import json as _json
+    from ceph_tpu.msg.messenger import Dispatcher, Messenger, Policy
+    from ceph_tpu.msg.messages import MPing
+
+    class Sink(Dispatcher):
+        def __init__(self):
+            self.got = []
+
+        async def ms_dispatch(self, conn, msg):
+            self.got.append(msg)
+            return True
+
+    async def body():
+        key = b"super-secret-cluster-key"
+        server = Messenger("srv", auth_key=key)
+        sink = Sink()
+        server.add_dispatcher(sink)
+        addr = await server.bind("127.0.0.1", 0)
+
+        # 1) matching key: messages flow
+        good = Messenger("cli-good", auth_key=key)
+        conn = await good.connect(addr, Policy.lossy_client())
+        conn.send_message(MPing({"stamp": 1}))
+        deadline = asyncio.get_running_loop().time() + 5
+        while not sink.got:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        await good.shutdown()
+
+        # 2) WRONG key: initiator detects the bad server proof
+        bad = Messenger("cli-bad", auth_key=b"wrong-key")
+        with pytest.raises(Exception):
+            await bad.connect(addr, Policy.lossy_client())
+        await bad.shutdown()
+
+        # 3) keyless client against an auth-required server: rejected,
+        # and nothing was dispatched for either bad peer
+        sink.got.clear()
+        nokey = Messenger("cli-nokey")
+        try:
+            conn = await nokey.connect(addr, Policy.lossy_client())
+            conn.send_message(MPing({"stamp": 2}))
+            await asyncio.sleep(0.3)
+        except Exception:
+            pass
+        assert not sink.got
+        await nokey.shutdown()
+        await server.shutdown()
+    asyncio.run(asyncio.wait_for(body(), 30))
