@@ -1,0 +1,229 @@
+"""S3-subset HTTP gateway.
+
+Re-creation of the reference RGW request path shape
+(src/rgw/rgw_asio_frontend.cc HTTP frontend -> rgw_process.cc:265
+process_request -> RGWOp handlers -> RADOS store driver):
+
+  * buckets:   PUT /bucket        create   (bucket index object with an
+                                           omap entry per object, like
+                                           cls_rgw's bucket index)
+               GET /bucket        list objects (XML ListBucketResult)
+               DELETE /bucket     remove (must be empty)
+               GET /              list buckets
+  * objects:   PUT /bucket/key    write (body = payload)
+               GET /bucket/key    read (+ ETag = crc32c hex)
+               HEAD /bucket/key   stat
+               DELETE /bucket/key remove
+
+Layout in RADOS: one data pool; bucket index object
+`.bucket.<name>` whose omap maps object key -> JSON {size, etag};
+object data in `<bucket>/<key>`. Multi-op semantics match S3's
+read-after-write for new objects.
+
+Idiomatic divergences: no auth sigv4 (cephx-lite guards the RADOS
+plane; HTTP is trusted-localhost like a behind-proxy deployment), XML
+only where S3 clients require it, single-part uploads only.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from urllib.parse import unquote
+from xml.sax.saxutils import escape
+
+from ceph_tpu.rados.client import IoCtx, ObjectNotFound
+from ceph_tpu.utils.dout import dout
+
+
+def _index_oid(bucket: str) -> str:
+    return f".bucket.{bucket}"
+
+
+def _data_oid(bucket: str, key: str) -> str:
+    return f"{bucket}/{key}"
+
+
+class RGWGateway:
+    """HTTP/1.0 S3-subset frontend bound to one RADOS pool."""
+
+    def __init__(self, ioctx: IoCtx, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.io = ioctx
+        self.host, self.port = host, port
+        self._server: asyncio.Server | None = None
+        self.addr: tuple[str, int] | None = None
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+        dout("rgw", 1, f"rgw-lite on {self.addr}")
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- request plumbing ----------------------------------------------------
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), 30.0)
+            parts = request.decode(errors="replace").split()
+            if len(parts) < 2:
+                return
+            method, path = parts[0].upper(), unquote(parts[1].split("?")[0])
+            length = 0
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 30.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                name, _, value = line.decode(errors="replace").partition(":")
+                if name.strip().lower() == "content-length":
+                    length = int(value.strip())
+            body = await reader.readexactly(length) if length else b""
+            code, headers, out = await self._process(method, path, body)
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                OSError):
+            writer.close()
+            return
+        except Exception as e:
+            dout("rgw", 1, f"request failed: {type(e).__name__} {e}")
+            code, headers, out = 500, {}, b"InternalError"
+        try:
+            hdr = "".join(f"{k}: {v}\r\n" for k, v in headers.items())
+            if "Content-Length" not in headers:
+                hdr += f"Content-Length: {len(out)}\r\n"
+            writer.write(
+                f"HTTP/1.0 {code} {_REASON.get(code, '')}\r\n{hdr}"
+                f"\r\n".encode() + out)
+            await writer.drain()
+        except OSError:
+            pass
+        finally:
+            writer.close()
+
+    # -- S3 semantics --------------------------------------------------------
+
+    async def _process(self, method: str, path: str,
+                       body: bytes) -> tuple[int, dict, bytes]:
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            if method == "GET":
+                return await self._list_buckets()
+            return 405, {}, b"MethodNotAllowed"
+        bucket, key = parts[0], "/".join(parts[1:])
+        if not key:
+            if method == "PUT":
+                return await self._create_bucket(bucket)
+            if method == "GET":
+                return await self._list_objects(bucket)
+            if method == "DELETE":
+                return await self._delete_bucket(bucket)
+            return 405, {}, b"MethodNotAllowed"
+        if method == "PUT":
+            return await self._put_object(bucket, key, body)
+        if method == "GET":
+            return await self._get_object(bucket, key)
+        if method == "HEAD":
+            return await self._head_object(bucket, key)
+        if method == "DELETE":
+            return await self._delete_object(bucket, key)
+        return 405, {}, b"MethodNotAllowed"
+
+    async def _bucket_exists(self, bucket: str) -> bool:
+        try:
+            await self.io.stat(_index_oid(bucket))
+            return True
+        except ObjectNotFound:
+            return False
+
+    async def _list_buckets(self) -> tuple[int, dict, bytes]:
+        names = sorted(o[len(".bucket."):]
+                       for o in await self.io.list_objects()
+                       if o.startswith(".bucket."))
+        inner = "".join(f"<Bucket><Name>{escape(n)}</Name></Bucket>"
+                        for n in names)
+        xml = (f"<ListAllMyBucketsResult><Buckets>{inner}</Buckets>"
+               f"</ListAllMyBucketsResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    async def _create_bucket(self, bucket: str) -> tuple[int, dict, bytes]:
+        if not await self._bucket_exists(bucket):
+            # a re-PUT of an existing bucket must NOT touch the index:
+            # write_full here would wipe its omap (S3 bucket PUT is
+            # idempotent)
+            await self.io.write_full(_index_oid(bucket), b"")
+        return 200, {}, b""
+
+    async def _delete_bucket(self, bucket: str) -> tuple[int, dict, bytes]:
+        if not await self._bucket_exists(bucket):
+            return 404, {}, b"NoSuchBucket"
+        if await self.io.omap_get(_index_oid(bucket)):
+            return 409, {}, b"BucketNotEmpty"
+        await self.io.remove(_index_oid(bucket))
+        return 204, {}, b""
+
+    async def _list_objects(self, bucket: str) -> tuple[int, dict, bytes]:
+        if not await self._bucket_exists(bucket):
+            return 404, {}, b"NoSuchBucket"
+        index = await self.io.omap_get(_index_oid(bucket))
+        items = []
+        for k in sorted(index):
+            meta = json.loads(index[k])
+            items.append(f"<Contents><Key>{escape(k)}</Key>"
+                         f"<Size>{meta['size']}</Size>"
+                         f"<ETag>&quot;{meta['etag']}&quot;</ETag>"
+                         f"</Contents>")
+        xml = (f"<ListBucketResult><Name>{escape(bucket)}</Name>"
+               f"{''.join(items)}</ListBucketResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    async def _put_object(self, bucket: str, key: str,
+                          body: bytes) -> tuple[int, dict, bytes]:
+        if not await self._bucket_exists(bucket):
+            return 404, {}, b"NoSuchBucket"
+        from ceph_tpu.native import ec_native
+        etag = f"{ec_native.crc32c(body):08x}"
+        await self.io.write_full(_data_oid(bucket, key), body)
+        # bucket index update AFTER the data lands (the reference's
+        # cls_rgw index transaction orders prepare/complete likewise)
+        await self.io.omap_set(_index_oid(bucket), {
+            key: json.dumps({"size": len(body), "etag": etag}).encode()})
+        return 200, {"ETag": f'"{etag}"'}, b""
+
+    async def _get_object(self, bucket: str,
+                          key: str) -> tuple[int, dict, bytes]:
+        try:
+            data = await self.io.read(_data_oid(bucket, key))
+        except ObjectNotFound:
+            return 404, {}, b"NoSuchKey"
+        from ceph_tpu.native import ec_native
+        return 200, {"ETag": f'"{ec_native.crc32c(data):08x}"',
+                     "Content-Type": "application/octet-stream"}, data
+
+    async def _head_object(self, bucket: str,
+                           key: str) -> tuple[int, dict, bytes]:
+        try:
+            st = await self.io.stat(_data_oid(bucket, key))
+        except ObjectNotFound:
+            return 404, {}, b""
+        # HEAD: the real object size IS the Content-Length (no body)
+        return 200, {"Content-Length": str(st["size"])}, b""
+
+    async def _delete_object(self, bucket: str,
+                             key: str) -> tuple[int, dict, bytes]:
+        try:
+            await self.io.remove(_data_oid(bucket, key))
+        except ObjectNotFound:
+            return 404, {}, b"NoSuchKey"
+        await self.io.omap_rm(_index_oid(bucket), [key])
+        return 204, {}, b""
+
+
+_REASON = {200: "OK", 204: "No Content", 404: "Not Found",
+           405: "Method Not Allowed", 409: "Conflict",
+           500: "Internal Server Error"}

@@ -1,0 +1,128 @@
+"""Operator tool suite: crushtool / monmaptool / osdmaptool /
+objectstore-tool analogs (§1.15; reference src/tools/)."""
+from __future__ import annotations
+
+import asyncio
+import json
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+
+
+def test_crushtool_build_and_test(tmp_path, capsys):
+    from ceph_tpu.tools.crushtool import main
+    out = tmp_path / "map.json"
+    assert main(["--build", "--num-osds", "9", "--osds-per-host", "3",
+                 "-o", str(out), "--test", "--num-rep", "3",
+                 "--samples", "600"]) == 0
+    text = capsys.readouterr().out
+    stats = json.loads(text[text.index("{"):])
+    assert stats["short_mappings"] == 0
+    assert stats["duplicate_mappings"] == 0
+    assert len(stats["utilization"]) == 9
+    # balanced within 25% of mean across osds
+    mean = stats["per_osd_mean"]
+    assert all(abs(c - mean) < 0.25 * mean
+               for c in stats["utilization"].values()), stats
+    # round trip through the file, indep mode for EC
+    assert main(["-i", str(out), "--test", "--mode", "indep",
+                 "--num-rep", "4", "--samples", "200"]) == 0
+
+
+def test_monmaptool_create_print(tmp_path, capsys):
+    from ceph_tpu.tools.monmaptool import main
+    out = tmp_path / "monmap.json"
+    assert main(["--create", "--add", "m0", "127.0.0.1:6789",
+                 "--add", "m1", "127.0.0.1:6790", "-o", str(out)]) == 0
+    assert main(["-i", str(out), "--rm", "m1", "--print"]) == 0
+    shown = capsys.readouterr().out
+    blob = json.loads(shown[shown.index("{"):])
+    assert "m0" in blob["mons"] and "m1" not in blob["mons"]
+    assert blob["ranks"] == ["m0"]
+
+
+def test_osdmaptool_on_live_dump(tmp_path, capsys):
+    from ceph_tpu.tools.osdmaptool import main
+
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=8, size=3)
+            dump = await cl.command({"prefix": "osd dump"})
+            (tmp_path / "osdmap.json").write_text(json.dumps(dump))
+        finally:
+            await c.stop()
+    run(body())
+    assert main(["-i", str(tmp_path / "osdmap.json"), "--print",
+                 "--test-map-pgs"]) == 0
+    out = capsys.readouterr().out
+    assert '"num_up_osds": 3' in out
+    assert '"short_mappings": 0' in out
+
+
+def test_objectstore_tool_export_import(tmp_path, capsys):
+    """Lift a PG off one (stopped) FileStore and import it into a fresh
+    one — the §5.4 disaster-recovery workflow."""
+    from ceph_tpu.objectstore import FileStore
+    from ceph_tpu.tools.objectstore_tool import main
+
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=3,
+                           store_factory=lambda i: FileStore(
+                               str(tmp_path / f"osd{i}")))
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=1, size=3)
+            io = cl.ioctx("rbd")
+            for i in range(10):
+                await io.write_full(f"o{i}", bytes([i]) * 100)
+            await io.omap_set("o0", {"k": b"v"})
+            await io.setxattr("o1", "color", b"red")
+        finally:
+            await c.stop()
+    run(body())
+
+    # list + export from the stopped osd0 store
+    assert main(["--data-path", str(tmp_path / "osd0"),
+                 "--op", "list"]) == 0
+    listing = capsys.readouterr().out
+    assert '"oid": "o3"' in listing
+    pgid = json.loads(listing.splitlines()[0])["pgid"]
+    export = tmp_path / "pg.export"
+    assert main(["--data-path", str(tmp_path / "osd0"), "--op", "export",
+                 "--pgid", pgid, "--file", str(export)]) == 0
+    capsys.readouterr()
+
+    # import into a brand-new store and verify byte equality
+    fresh = FileStore(str(tmp_path / "fresh"))
+    fresh.mkfs()
+    fresh.mount()
+    fresh.umount()
+    assert main(["--data-path", str(tmp_path / "fresh"), "--op", "import",
+                 "--file", str(export)]) == 0
+    src = FileStore(str(tmp_path / "osd0"))
+    src.mount()
+    dst = FileStore(str(tmp_path / "fresh"))
+    dst.mount()
+    try:
+        pool, ps = (int(x) for x in pgid.split("."))
+        from ceph_tpu.objectstore.types import CollectionId
+        cid = CollectionId.make_pg(pool, ps, -1)
+        src_objs = {gh.name: gh for gh in src.collection_list(cid)}
+        dst_objs = {gh.name: gh for gh in dst.collection_list(cid)}
+        assert set(src_objs) == set(dst_objs)
+        for name, gh in src_objs.items():
+            assert src.read(cid, gh) == dst.read(cid, dst_objs[name])
+            assert src.getattrs(cid, gh) == dst.getattrs(
+                cid, dst_objs[name])
+            assert src.omap_get(cid, gh) == dst.omap_get(
+                cid, dst_objs[name])
+    finally:
+        src.umount()
+        dst.umount()
+
+    # remove
+    assert main(["--data-path", str(tmp_path / "fresh"), "--op", "remove",
+                 "--pgid", pgid, "--oid", "o5"]) == 0
