@@ -52,6 +52,13 @@ class Pool:
     crush_rule: int = 0
     ec_profile: str = ""
     stripe_width: int = 0
+    # snapshots (pg_pool_t snap_seq/snaps/removed_snaps): snap ids are
+    # allocated from snap_seq; pool_snaps names the pool-level ones
+    # (str keys: the record round-trips through JSON); removed ids are
+    # what OSD snaptrim consumes
+    snap_seq: int = 0
+    pool_snaps: dict = dataclasses.field(default_factory=dict)
+    removed_snaps: list = dataclasses.field(default_factory=list)
 
     def pg_mask(self) -> int:
         return (1 << (self.pg_num - 1).bit_length()) - 1 if self.pg_num else 0

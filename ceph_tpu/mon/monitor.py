@@ -236,6 +236,55 @@ class OSDMonitor:
         return {"pool": name, "pool_id": pid, "size": size,
                 "min_size": min_size, "crush_rule": rule_id}
 
+    def cmd_pool_snap(self, pool_name: str, action: str,
+                      snap_name: str | None = None,
+                      snapid: int | None = None) -> dict:
+        """Pool + self-managed snapshot id allocation/removal
+        (OSDMonitor prepare_pool_op SNAP_CREATE/SNAP_DELETE and
+        IoCtxImpl::selfmanaged_snap_create's mon round-trip): snap ids
+        are monotonically allocated from the pool's snap_seq; removals
+        land in removed_snaps for the OSDs' snaptrim to consume."""
+        import dataclasses as _dc
+        pid = self.osdmap.pool_names.get(pool_name)
+        if pid is None:
+            raise ValueError(f"pool {pool_name!r} does not exist")
+        if self.osdmap.pools[pid].type != "replicated":
+            # snapshots require replicated pools here (one bad mksnap
+            # would otherwise stamp snapc on every write and brick the
+            # pool with EOPNOTSUPP)
+            raise ValueError(
+                f"pool {pool_name!r} is {self.osdmap.pools[pid].type}: "
+                f"snapshots require a replicated pool")
+        pending = self.get_pending()
+        base = pending.new_pools.get(pid, self.osdmap.pools[pid])
+        p = _dc.replace(base, pool_snaps=dict(base.pool_snaps),
+                        removed_snaps=list(base.removed_snaps))
+        if action == "mksnap":
+            if snap_name in p.pool_snaps.values():
+                raise ValueError(f"snap {snap_name!r} exists")
+            sid = p.snap_seq + 1
+            p.snap_seq = sid
+            p.pool_snaps[str(sid)] = snap_name
+        elif action == "rmsnap":
+            sid = next((int(k) for k, v in p.pool_snaps.items()
+                        if v == snap_name), None)
+            if sid is None:
+                raise ValueError(f"snap {snap_name!r} does not exist")
+            del p.pool_snaps[str(sid)]
+            p.removed_snaps.append(sid)
+        elif action == "selfmanaged_create":
+            sid = p.snap_seq + 1
+            p.snap_seq = sid
+        elif action == "selfmanaged_rm":
+            sid = int(snapid)
+            if sid not in p.removed_snaps:
+                p.removed_snaps.append(sid)
+            p.snap_seq = max(p.snap_seq, sid)
+        else:
+            raise ValueError(f"unknown snap action {action!r}")
+        pending.new_pools[pid] = p
+        return {"snapid": sid, "pool": pool_name}
+
     def handle_boot(self, payload: dict) -> bool:
         """MOSDBoot: add under crush_location, mark up. True if changed."""
         osd = payload["osd"]
@@ -640,6 +689,27 @@ class Monitor(Dispatcher):
                 erasure_code_profile=cmd.get("erasure_code_profile", ""),
                 crush_failure_domain=int(cmd.get("crush_failure_domain", 1)))
             await om.propose_pending()
+            return out
+        if prefix in ("osd pool mksnap", "osd pool rmsnap",
+                      "osd pool selfmanaged snap create",
+                      "osd pool selfmanaged snap rm"):
+            if prefix.endswith("mksnap"):
+                out = om.cmd_pool_snap(cmd["pool"], "mksnap",
+                                       snap_name=cmd["snap"])
+            elif prefix.endswith("rmsnap"):
+                out = om.cmd_pool_snap(cmd["pool"], "rmsnap",
+                                       snap_name=cmd["snap"])
+            elif prefix.endswith("create"):
+                out = om.cmd_pool_snap(cmd["pool"], "selfmanaged_create")
+            else:
+                out = om.cmd_pool_snap(cmd["pool"], "selfmanaged_rm",
+                                       snapid=int(cmd["snapid"]))
+            await om.propose_pending()
+            # the epoch the snap committed in (>= is enough: any map at
+            # this epoch carries the mutated pool record) — clients wait
+            # on THIS, not on "my epoch + 1", which a concurrent
+            # unrelated proposal could satisfy early
+            out["epoch"] = om.osdmap.epoch
             return out
         if prefix in ("osd out", "osd in", "osd down"):
             ids = [int(i) for i in cmd.get("ids", [])]

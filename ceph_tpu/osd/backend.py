@@ -158,8 +158,29 @@ class PGBackend:
             if self.host.store.exists(cid, gh):
                 txn.omap_rmkeys(cid, gh, json.loads(data))
         elif op in ("delete", "remove"):
+            # client delete removes HEAD only; clones/snapdir survive
+            # (make_writeable has already cloned when a snapc required)
             if self.host.store.exists(cid, gh):
                 txn.remove(cid, gh)
+        elif op == "clone":
+            from ceph_tpu.osd import snaps
+            p = json.loads(data)
+            snaps.apply_clone(self.host.store, cid, gh, self.pg._meta_gh(),
+                              p["cloneid"], p["snaps"], p["seq_only"])
+            return
+        elif op == "rollback":
+            from ceph_tpu.osd import snaps
+            snaps.apply_rollback(self.host.store, cid, gh, int(data))
+            return
+        elif op == "snaptrim":
+            from ceph_tpu.osd import snaps
+            snaps.apply_snaptrim(self.host.store, cid, gh,
+                                 self.pg._meta_gh(), int(data))
+            return
+        elif op == "purge":
+            from ceph_tpu.osd import snaps
+            snaps.purge_object(self.host.store, cid, gh, self.pg._meta_gh())
+            return
         else:
             raise StoreError("EINVAL", f"unknown backend op {op!r}")
         self.host.store.queue_transaction(txn)
@@ -207,23 +228,41 @@ class PGBackend:
 
     def apply_push(self, oid: str, data: bytes, attrs: dict,
                    delete: bool, shard: int = -1,
-                   omap: dict[str, bytes] | None = None) -> None:
+                   omap: dict[str, bytes] | None = None,
+                   snap_state: dict | None = None) -> None:
         if delete:
             self.local_apply(oid, "delete", b"", shard=shard)
         else:
             self.local_apply(oid, "push", data, attrs=attrs, shard=shard,
                              omap=omap)
+        if self.pg.pool.type == "replicated":
+            # full-state push replaces snapshot state too (clears stale
+            # clones when the authoritative object has none)
+            from ceph_tpu.osd import snaps
+            snaps.apply_snap_push(self.host.store, self.coll(shard),
+                                  self.ghobject(oid, shard),
+                                  self.pg._meta_gh(), snap_state)
+
+    def snap_state_for_push(self, oid: str) -> dict | None:
+        if self.pg.pool.type != "replicated":
+            return None
+        from ceph_tpu.osd import snaps
+        return snaps.snap_state_for_push(self.host.store, self.coll(),
+                                         self.ghobject(oid))
 
     async def push_object(self, peer: int, oid: str) -> None:
         """Push this object's local state (or its absence) to `peer`.
         The EC backend overrides this to reconstruct the peer's
         positional chunk instead."""
+        snap_state = self.snap_state_for_push(oid)
         if self.local_exists(oid):
             data, attrs = self.read_for_push(oid)
             await self.pg.send_push(peer, oid, data, attrs, delete=False,
-                                    omap=self.omap_for_push(oid))
+                                    omap=self.omap_for_push(oid),
+                                    snap_state=snap_state)
         else:
-            await self.pg.send_push(peer, oid, b"", None, delete=True)
+            await self.pg.send_push(peer, oid, b"", None, delete=True,
+                                    snap_state=snap_state)
 
     async def pull_object(self, auth_peer: int, oid: str, need,
                           fallbacks=()) -> None:

@@ -354,7 +354,12 @@ class OSD(Dispatcher):
                         continue
                     inst = PGInstance(self, pgid, pool)
                     self.pgs[pgid] = inst
+                # pool records mutate across epochs (snap create/rm):
+                # the PG must see the current one, then react to newly
+                # removed snaps
+                inst.pool = pool
                 inst.advance_map(up, acting)
+                inst.maybe_snaptrim()
         # parked ops whose PG lost primacy (or went straight to active)
         # must not wait forever
         for pgid in list(self._waiting_for_active):

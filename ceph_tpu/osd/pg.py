@@ -85,6 +85,9 @@ class PGInstance:
         self._active_writes = 0
         self._writes_drained = asyncio.Event()
         self._writes_drained.set()
+        # snaps this primary has finished trimming (persisted in meta)
+        self.purged_snaps: set[int] = set()
+        self._snaptrim_task: asyncio.Task | None = None
         if pool.type == "erasure":
             from ceph_tpu.osd.ec_backend import ECBackend
             self.backend = ECBackend(self)
@@ -126,7 +129,9 @@ class PGInstance:
 
     def persist_meta(self) -> None:
         blob = json.dumps({"log": self.log.to_dict(), "seq": self.seq,
-                           "les": self.last_epoch_started}).encode()
+                           "les": self.last_epoch_started,
+                           "purged_snaps": sorted(self.purged_snaps)}
+                          ).encode()
         cid = self.backend.coll()
         gh = self._meta_gh()
         txn = Transaction()
@@ -145,13 +150,35 @@ class PGInstance:
         self.log = PGLog.from_dict(meta["log"])
         self.seq = meta.get("seq", self.log.head[1])
         self.last_epoch_started = meta.get("les", 0)
+        self.purged_snaps = set(meta.get("purged_snaps", []))
 
     def list_objects(self) -> list[str]:
+        from ceph_tpu.objectstore.types import CEPH_NOSNAP
         from ceph_tpu.osd.ec_backend import PREV_SUFFIX
         cid = self.backend.coll()
         return sorted(gh.name for gh in self.host.store.collection_list(cid)
                       if gh.name != PGMETA_OID
-                      and not gh.name.endswith(PREV_SUFFIX))
+                      and not gh.name.endswith(PREV_SUFFIX)
+                      and gh.snap == CEPH_NOSNAP)
+
+    def recovery_objects(self) -> list[str]:
+        """Everything recovery/backfill must move: heads plus headless
+        objects whose clones/snapdir survive a head delete."""
+        from ceph_tpu.osd import snaps
+        names = set(self.list_objects())
+        if self.pool.type == "replicated":
+            names |= snaps.headless_snap_objects(self.host.store,
+                                                 self.backend.coll())
+        names.discard(PGMETA_OID)
+        return sorted(names)
+
+    def _purge_stray(self, oid: str) -> None:
+        """Drop a stray object found during backfill: unlike a client
+        delete, its snapshot state goes with it."""
+        if self.pool.type == "replicated":
+            self.backend.local_apply(oid, "purge", b"")
+        else:
+            self.backend.local_apply(oid, "delete", b"")
 
     # -- map advance ---------------------------------------------------------
 
@@ -194,6 +221,10 @@ class PGInstance:
                 not self._recovery_task.done():
             self._recovery_task.cancel()
         self._recovery_task = None
+        if self._snaptrim_task is not None and \
+                not self._snaptrim_task.done():
+            self._snaptrim_task.cancel()
+        self._snaptrim_task = None
         self._pending_recovery.clear()
         self._deferred_activate.clear()
         for fut in self._peer_waiters.values():
@@ -329,7 +360,7 @@ class PGInstance:
                 # drop strays (deletes it missed past the log window
                 # would otherwise resurrect if it later became primary)
                 if my_objects is None:
-                    my_objects = self.list_objects()
+                    my_objects = self.recovery_objects()
                 need_oids = list(my_objects)
                 act_payload["objects"] = my_objects
             else:
@@ -368,6 +399,7 @@ class PGInstance:
         if pending:
             self._recovery_task = asyncio.get_running_loop().create_task(
                 self._drain_recovery())
+        self.maybe_snaptrim()
 
     # -- async recovery / backfill (primary side) ----------------------------
 
@@ -453,7 +485,7 @@ class PGInstance:
                            "epoch": self.last_epoch_started,
                            "from": self.host.whoami, "log": log_dict}
             if shape.get("backfill"):
-                act_payload["objects"] = self.list_objects()
+                act_payload["objects"] = self.recovery_objects()
             try:
                 await self.host.send_osd(peer, MOSDPGInfo(act_payload))
             except Exception as e:
@@ -488,9 +520,9 @@ class PGInstance:
         auth_objects = set(reply["objects"])
         for oid in sorted(auth_objects):
             await self.backend.pull_object(auth_osd, oid, None)
-        for oid in self.list_objects():
+        for oid in self.recovery_objects():
             if oid not in auth_objects:
-                self.backend.local_apply(oid, "delete", b"")
+                self._purge_stray(oid)
         new_log = PGLog()
         new_log.entries = list(auth_entries)
         new_log.head, new_log.tail = auth_head, auth_tail
@@ -514,7 +546,8 @@ class PGInstance:
 
     async def send_push(self, peer: int, oid: str, data: bytes,
                         attrs: dict | None, delete: bool,
-                        omap: dict | None = None) -> None:
+                        omap: dict | None = None,
+                        snap_state: dict | None = None) -> None:
         payload = {"pgid": [self.pgid.pool, self.pgid.ps], "op": "push",
                    "from": self.host.whoami, "oid": oid, "delete": delete}
         if attrs:
@@ -523,6 +556,8 @@ class PGInstance:
         if omap is not None:
             payload["omap"] = {k: v.decode("latin1")
                                for k, v in omap.items()}
+        if snap_state is not None:
+            payload["snap_state"] = snap_state
         await self.host.send_osd(peer, MOSDPGPush(payload, data))
 
     # -- peering message handlers (both roles) -------------------------------
@@ -535,7 +570,7 @@ class PGInstance:
                    "from": self.host.whoami, "info": self.info(),
                    "entries": [e.to_dict() for e in self.log.entries]}
         if msg.payload.get("want") == "objects":
-            payload["objects"] = self.list_objects()
+            payload["objects"] = self.recovery_objects()
         conn.send_message(MOSDPGLog(payload))
 
     def handle_log(self, msg: MOSDPGLog) -> None:
@@ -549,22 +584,26 @@ class PGInstance:
         if p["op"] == "pull":
             # serve the object back to the puller
             oid = p["oid"]
+            snap_state = self.backend.snap_state_for_push(oid)
             if self.backend.local_exists(oid):
                 data, attrs = self.backend.read_for_push(oid)
                 omap = self.backend.omap_for_push(oid)
-                conn.send_message(MOSDPGPush(
-                    {"pgid": p["pgid"], "op": "push",
-                     "from": self.host.whoami, "oid": oid, "delete": False,
-                     "attrs": {k: v.decode("latin1")
-                               for k, v in attrs.items()},
-                     "omap": {k: v.decode("latin1")
-                              for k, v in omap.items()},
-                     "reply_to": "pull"}, data))
+                payload = {"pgid": p["pgid"], "op": "push",
+                           "from": self.host.whoami, "oid": oid,
+                           "delete": False,
+                           "attrs": {k: v.decode("latin1")
+                                     for k, v in attrs.items()},
+                           "omap": {k: v.decode("latin1")
+                                    for k, v in omap.items()},
+                           "reply_to": "pull"}
             else:
-                conn.send_message(MOSDPGPush(
-                    {"pgid": p["pgid"], "op": "push",
-                     "from": self.host.whoami, "oid": oid, "delete": True,
-                     "reply_to": "pull"}))
+                payload = {"pgid": p["pgid"], "op": "push",
+                           "from": self.host.whoami, "oid": oid,
+                           "delete": True, "reply_to": "pull"}
+                data = b""
+            if snap_state is not None:
+                payload["snap_state"] = snap_state
+            conn.send_message(MOSDPGPush(payload, data))
             return
         # incoming object state
         attrs = {k: v.encode("latin1")
@@ -572,7 +611,7 @@ class PGInstance:
         omap = ({k: v.encode("latin1") for k, v in p["omap"].items()}
                 if "omap" in p else None)
         self.backend.apply_push(p["oid"], msg.data, attrs, p["delete"],
-                                omap=omap)
+                                omap=omap, snap_state=p.get("snap_state"))
         self.log.mark_recovered(p["oid"])
         if p.get("reply_to") == "pull":
             fut = self._push_waiters.get(f"pull:{p['oid']}")
@@ -582,6 +621,53 @@ class PGInstance:
             conn.send_message(MOSDPGPushReply(
                 {"pgid": p["pgid"], "oid": p["oid"],
                  "from": self.host.whoami}))
+
+    # -- snaptrim (primary background task) ----------------------------------
+
+    def maybe_snaptrim(self) -> None:
+        """Start trimming snaps the monitor has removed (pool
+        removed_snaps vs our purged set) — called on activation and on
+        every map advance that updates the pool record."""
+        if (self.pool.type != "replicated" or not self.is_primary()
+                or self.state != "active"):
+            return
+        todo = set(getattr(self.pool, "removed_snaps", ())) \
+            - self.purged_snaps
+        if not todo:
+            return
+        if self._snaptrim_task is not None and \
+                not self._snaptrim_task.done():
+            return
+        self._snaptrim_task = asyncio.get_running_loop().create_task(
+            self._snaptrim(sorted(todo)))
+
+    async def _snaptrim(self, snapids: list[int]) -> None:
+        from ceph_tpu.osd import snaps as snapmod
+        try:
+            for snapid in snapids:
+                names = snapmod.snapmapper_objects(
+                    self.host.store, self.backend.coll(), self._meta_gh(),
+                    snapid)
+                for oid in names:
+                    await self._do_modify(
+                        "snaptrim", oid,
+                        {"oid": oid, "snapid": snapid}, b"")
+                    await asyncio.sleep(0)     # yield between objects
+                self.purged_snaps.add(snapid)
+                self.persist_meta()
+                dout("osd", 3, f"pg {self.pgid} snaptrim {snapid}: "
+                               f"{len(names)} objects")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            dout("osd", 2, f"pg {self.pgid} snaptrim failed: "
+                           f"{type(e).__name__} {e} (retried on next "
+                           f"map advance)")
+        else:
+            # a snap removed WHILE this batch ran would otherwise wait
+            # for an unrelated future epoch: re-check before parking
+            self._snaptrim_task = None
+            self.maybe_snaptrim()
 
     # -- scrub ---------------------------------------------------------------
 
@@ -640,9 +726,9 @@ class PGInstance:
             # backfill activation: anything we hold outside the
             # authoritative set is a stray from before our outage
             auth_objects = set(p["objects"])
-            for oid in self.list_objects():
+            for oid in self.recovery_objects():
                 if oid not in auth_objects:
-                    self.backend.local_apply(oid, "delete", b"")
+                    self._purge_stray(oid)
         auth = PGLog.from_dict(p["log"])
         self.log = auth
         self.log.clear_missing()
@@ -657,14 +743,17 @@ class PGInstance:
     # ops that mutate object state and therefore get a log entry
     MOD_OPS = frozenset({"write_full", "write", "append", "truncate",
                          "zero", "create", "delete", "setxattr", "rmxattr",
-                         "omap_set", "omap_rm"})
+                         "omap_set", "omap_rm", "rollback", "snaptrim"})
     # the reference rejects omap on EC pools (PrimaryLogPG.cc
     # pool.info.supports_omap()); truncate/zero/xattr need machinery our
     # EC backend does not carry per shard yet, so they are gated the
-    # same way (divergence: the reference allows xattrs + truncate on EC)
+    # same way (divergence: the reference allows xattrs + truncate on
+    # EC; snapshots require replicated pools here, like pre-overwrite
+    # EC in the reference)
     EC_UNSUPPORTED = frozenset({"truncate", "zero", "setxattr", "rmxattr",
                                 "omap_set", "omap_rm", "omap_get",
-                                "omap_vals", "getxattr", "getxattrs"})
+                                "omap_vals", "getxattr", "getxattrs",
+                                "rollback", "snaptrim", "list_snaps"})
 
     async def do_op(self, op: dict, data: bytes) -> tuple[int, dict, bytes]:
         """Execute one client op; returns (rc, out, outdata) — the
@@ -679,11 +768,17 @@ class PGInstance:
         mark_op_event("started")
         oid = op["oid"]
         kind = op["op"]
-        if self.pool.type == "erasure" and kind in self.EC_UNSUPPORTED:
+        if self.pool.type == "erasure" and (
+                kind in self.EC_UNSUPPORTED
+                or op.get("snapc") or op.get("snapid") is not None):
             return -95, {"error": f"EOPNOTSUPP: {kind} on an ec pool"}, b""
 
         if kind in self.MOD_OPS:
             return await self._do_modify(kind, oid, op, data)
+
+        snapid = op.get("snapid")
+        if snapid is not None and kind in ("read", "stat"):
+            return self._do_snap_read(kind, oid, op, snapid)
 
         if kind == "read":
             try:
@@ -698,6 +793,16 @@ class PGInstance:
             except StoreError as e:
                 return self._store_rc(e), {"error": str(e)}, b""
             return 0, {"size": size}, b""
+        if kind == "list_snaps":
+            from ceph_tpu.osd import snaps
+            ss = snaps.load_snapset(self.host.store, self.backend.coll(),
+                                    self.backend.ghobject(oid))
+            head_exists = self.backend.local_exists(oid)
+            if ss is None and not head_exists:
+                return -2, {"error": "ENOENT"}, b""
+            return 0, {"seq": ss.seq if ss else 0,
+                       "clones": list(ss.clones) if ss else [],
+                       "head_exists": head_exists}, b""
         if kind == "getxattr":
             if not await self.backend.object_exists(oid):
                 return -2, {"error": "ENOENT"}, b""
@@ -776,6 +881,9 @@ class PGInstance:
 
         async def apply(kind2: str, extra: dict, data2: bytes) -> dict:
             o = {"oid": oid, **extra}
+            if op.get("snapc"):
+                # staged cls mutations clone-on-write like plain ops
+                o["snapc"] = op["snapc"]
             if op.get("reqid"):
                 # distinct dup-index key per staged sub-mutation
                 o["reqid"] = [*op["reqid"], 100 + sub[0]]
@@ -801,6 +909,27 @@ class PGInstance:
         except ClassCallError as e:
             return e.rc, {"error": str(e)}, b""
         return 0, last, out or b""
+
+    def _do_snap_read(self, kind: str, oid: str, op: dict,
+                      snapid: int) -> tuple[int, dict, bytes]:
+        """Snap-directed read/stat (find_object_context: head, covering
+        clone, or ENOENT when the object did not exist at that snap)."""
+        from ceph_tpu.osd import snaps
+        store, cid = self.host.store, self.backend.coll()
+        head = self.backend.ghobject(oid)
+        ss = snaps.load_snapset(store, cid, head)
+        src = snaps.resolve_read(ss, snapid, store.exists(cid, head))
+        if src is None:
+            return -2, {"error": f"ENOENT at snap {snapid}"}, b""
+        gh = head if src == "head" else snaps.clone_gh(head, src)
+        try:
+            if kind == "stat":
+                return 0, {"size": store.stat(cid, gh)["size"]}, b""
+            data = store.read(cid, gh)
+        except StoreError as e:
+            return self._store_rc(e), {"error": str(e)}, b""
+        off, ln = op.get("off", 0), op.get("len", 0)
+        return 0, {}, data[off:off + ln] if ln > 0 else data[off:]
 
     @staticmethod
     def _store_rc(e: StoreError) -> int:
@@ -856,6 +985,25 @@ class PGInstance:
             # (the reference returns ENOENT; setxattr/omap_set create)
             if not await self.backend.object_exists(oid):
                 return -2, {"error": "ENOENT"}, b""
+        if kind == "rollback":
+            from ceph_tpu.osd import snaps as snapmod
+            head = self.backend.ghobject(oid)
+            ss = snapmod.load_snapset(self.host.store, self.backend.coll(),
+                                      head)
+            if snapmod.resolve_read(
+                    ss, op["snapid"],
+                    self.backend.local_exists(oid)) is None:
+                return -2, {"error": f"ENOENT at snap {op['snapid']}"}, b""
+            data = str(op["snapid"]).encode()
+        elif kind == "snaptrim":
+            data = str(op["snapid"]).encode()
+        # make_writeable (PrimaryLogPG.cc): the first mutation after new
+        # snaps appear in the client's SnapContext preserves the current
+        # state as a clone, via its own logged+replicated op
+        snapc = op.get("snapc")
+        if (snapc and snapc.get("snaps")
+                and self.pool.type == "replicated" and kind != "snaptrim"):
+            await self._make_writeable(oid, snapc, op.get("reqid"))
         if kind == "zero":
             # re-executed on replicas: the length rides the data segment
             data = str(op.get("len", 0)).encode()
@@ -881,6 +1029,25 @@ class PGInstance:
         self.log.append(entry)
         self.persist_meta()
         return 0, {"version": list(version)}, b""
+
+    async def _make_writeable(self, oid: str, snapc: dict,
+                              reqid) -> None:
+        from ceph_tpu.osd import snaps as snapmod
+        ss = snapmod.load_snapset(self.host.store, self.backend.coll(),
+                                  self.backend.ghobject(oid))
+        seq = ss.seq if ss else 0
+        new = [s for s in snapc["snaps"] if s > seq]
+        if not new:
+            return
+        head_exists = self.backend.local_exists(oid)
+        payload = json.dumps({"cloneid": max(new), "snaps": sorted(new),
+                              "seq_only": not head_exists}).encode()
+        entry = LogEntry(version=self.next_version(), op="modify", oid=oid,
+                         prior_version=self._prior(oid),
+                         reqid=(*reqid, 90) if reqid else None)
+        await self.backend.execute_write(oid, "clone", payload, entry)
+        self.log.append(entry)
+        self.persist_meta()
 
     def _prior(self, oid: str) -> Eversion:
         for e in reversed(self.log.entries):

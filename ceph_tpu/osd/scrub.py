@@ -279,13 +279,18 @@ async def _compare_repair_replicated(pg: "PGInstance", maps: dict,
             continue
         try:
             if auth_fp == ABSENT:
-                # the delete is authoritative: finish it on the holders
+                # the delete is authoritative: finish it on the holders.
+                # The push carries the primary's snapshot state so a
+                # delete-repair can't wipe legitimate clones the target
+                # replica holds (head deletes preserve clones)
+                snap_state = pg.backend.snap_state_for_push(oid)
                 for osd in bad:
                     if osd == me:
                         pg.backend.local_apply(oid, "delete", b"")
                     else:
                         await pg.send_push(osd, oid, b"", None,
-                                           delete=True)
+                                           delete=True,
+                                           snap_state=snap_state)
                     repaired += 1
                 continue
             if me in bad:

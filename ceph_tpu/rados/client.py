@@ -229,68 +229,160 @@ class RadosClient(Dispatcher):
 class IoCtx:
     """Synchronous-ish per-pool I/O context (librados IoCtx)."""
 
+    #: op kinds that mutate state and therefore carry a SnapContext
+    #: ("call" may stage writes server-side, so it carries one too)
+    MOD_KINDS = frozenset({"write_full", "write", "append", "truncate",
+                           "zero", "create", "delete", "setxattr",
+                           "rmxattr", "omap_set", "omap_rm", "rollback",
+                           "call"})
+
     def __init__(self, client: RadosClient, pool_name: str):
         self.client = client
         self.pool_name = pool_name
+        # self-managed SnapContext (seq + snap ids, newest first); when
+        # unset, writes use the pool-snap context from the osdmap
+        self._snapc: dict | None = None
+
+    # -- snapshots (librados snap API subset) --------------------------------
+
+    def set_snap_context(self, seq: int, snaps: list[int]) -> None:
+        """rados_ioctx_selfmanaged_snap_set_write_ctx: snaps newest
+        first. (0, []) reverts to the pool-snap context — the reference
+        forbids mixing pool and self-managed snaps in one pool; keep
+        them in separate pools."""
+        if seq == 0 and not snaps:
+            self._snapc = None
+            return
+        self._snapc = {"seq": seq, "snaps": sorted(snaps, reverse=True)}
+
+    def _snap_context(self) -> dict | None:
+        if self._snapc is not None:
+            return self._snapc
+        pool = self.client.osdmap.get_pool(self.pool_name)
+        if pool is None or not pool.pool_snaps:
+            return None
+        snaps = sorted((int(s) for s in pool.pool_snaps), reverse=True)
+        return {"seq": pool.snap_seq, "snaps": snaps}
+
+    async def selfmanaged_snap_create(self) -> int:
+        out = await self.client.command(
+            {"prefix": "osd pool selfmanaged snap create",
+             "pool": self.pool_name})
+        return out["snapid"]
+
+    async def selfmanaged_snap_rm(self, snapid: int) -> None:
+        out = await self.client.command(
+            {"prefix": "osd pool selfmanaged snap rm",
+             "pool": self.pool_name, "snapid": snapid})
+        # wait for the COMMITTED epoch from the reply (a concurrent
+        # unrelated proposal could satisfy "my epoch + 1" early)
+        await self.client.wait_for_map(out["epoch"])
+
+    async def snap_create(self, name: str) -> int:
+        out = await self.client.command(
+            {"prefix": "osd pool mksnap", "pool": self.pool_name,
+             "snap": name})
+        # writes must see the new pool record or they won't clone: wait
+        # for the committed epoch the mon reported
+        await self.client.wait_for_map(out["epoch"])
+        return out["snapid"]
+
+    async def snap_rm(self, name: str) -> None:
+        out = await self.client.command(
+            {"prefix": "osd pool rmsnap", "pool": self.pool_name,
+             "snap": name})
+        await self.client.wait_for_map(out["epoch"])
+
+    def snap_list(self) -> dict[str, int]:
+        pool = self.client.osdmap.get_pool(self.pool_name)
+        return {v: int(k) for k, v in (pool.pool_snaps or {}).items()}
+
+    def snap_lookup(self, name: str) -> int:
+        sid = self.snap_list().get(name)
+        if sid is None:
+            raise RadosError(-2, f"snap {name!r} not found")
+        return sid
+
+    async def rollback(self, oid: str, snapid: int) -> dict:
+        p, _ = await self._submit(
+            oid, [{"op": "rollback", "oid": oid, "snapid": snapid}])
+        return p
+
+    async def snap_rollback(self, oid: str, snap_name: str) -> dict:
+        return await self.rollback(oid, self.snap_lookup(snap_name))
+
+    async def list_snaps(self, oid: str) -> dict:
+        p, _ = await self.client.submit(
+            self.pool_name, oid, [{"op": "list_snaps", "oid": oid}])
+        return p["results"][0]["out"]
+
+    async def _submit(self, oid: str, ops: list[dict],
+                      data: bytes = b"") -> tuple[dict, bytes]:
+        """Mutation submit: stamps each modifying op with the current
+        SnapContext (IoCtxImpl::operate attaching the io ctx snapc)."""
+        snapc = self._snap_context()
+        if snapc is not None:
+            for op in ops:
+                if op["op"] in self.MOD_KINDS:
+                    op.setdefault("snapc", snapc)
+        return await self.client.submit(self.pool_name, oid, ops, data)
 
     async def write_full(self, oid: str, data: bytes) -> dict:
-        p, _ = await self.client.submit(
-            self.pool_name, oid, [{"op": "write_full", "oid": oid}], data)
+        p, _ = await self._submit(
+            oid, [{"op": "write_full", "oid": oid}], data)
         return p
 
     async def write(self, oid: str, data: bytes, offset: int = 0) -> dict:
         """Ranged write (rados_write): extends the object as needed; on
         EC pools this drives the RMW partial-stripe pipeline."""
-        p, _ = await self.client.submit(
-            self.pool_name, oid,
-            [{"op": "write", "oid": oid, "off": offset}], data)
+        p, _ = await self._submit(
+            oid, [{"op": "write", "oid": oid, "off": offset}], data)
         return p
 
     async def append(self, oid: str, data: bytes) -> dict:
-        p, _ = await self.client.submit(
-            self.pool_name, oid, [{"op": "append", "oid": oid}], data)
+        p, _ = await self._submit(
+            oid, [{"op": "append", "oid": oid}], data)
         return p
 
     async def create(self, oid: str, exclusive: bool = True) -> dict:
-        p, _ = await self.client.submit(
-            self.pool_name, oid,
-            [{"op": "create", "oid": oid, "exclusive": exclusive}])
+        p, _ = await self._submit(
+            oid, [{"op": "create", "oid": oid, "exclusive": exclusive}])
         return p
 
     async def truncate(self, oid: str, size: int) -> dict:
-        p, _ = await self.client.submit(
-            self.pool_name, oid,
-            [{"op": "truncate", "oid": oid, "size": size}])
+        p, _ = await self._submit(
+            oid, [{"op": "truncate", "oid": oid, "size": size}])
         return p
 
     async def zero(self, oid: str, offset: int, length: int) -> dict:
-        p, _ = await self.client.submit(
-            self.pool_name, oid,
-            [{"op": "zero", "oid": oid, "off": offset, "len": length}])
+        p, _ = await self._submit(
+            oid, [{"op": "zero", "oid": oid, "off": offset, "len": length}])
         return p
 
-    async def read(self, oid: str, offset: int = 0, length: int = 0) -> bytes:
-        _, data = await self.client.submit(
-            self.pool_name, oid,
-            [{"op": "read", "oid": oid, "off": offset, "len": length}])
+    async def read(self, oid: str, offset: int = 0, length: int = 0,
+                   snapid: int | None = None) -> bytes:
+        op = {"op": "read", "oid": oid, "off": offset, "len": length}
+        if snapid is not None:
+            op["snapid"] = snapid
+        _, data = await self.client.submit(self.pool_name, oid, [op])
         return data
 
     async def remove(self, oid: str) -> dict:
-        p, _ = await self.client.submit(
-            self.pool_name, oid, [{"op": "delete", "oid": oid}])
+        p, _ = await self._submit(oid, [{"op": "delete", "oid": oid}])
         return p
 
-    async def stat(self, oid: str) -> dict:
-        p, _ = await self.client.submit(
-            self.pool_name, oid, [{"op": "stat", "oid": oid}])
+    async def stat(self, oid: str, snapid: int | None = None) -> dict:
+        op = {"op": "stat", "oid": oid}
+        if snapid is not None:
+            op["snapid"] = snapid
+        p, _ = await self.client.submit(self.pool_name, oid, [op])
         return p["results"][0]["out"]
 
     # -- xattrs / omap (replicated pools; EC pools return EOPNOTSUPP) ---------
 
     async def setxattr(self, oid: str, name: str, value: bytes) -> dict:
-        p, _ = await self.client.submit(
-            self.pool_name, oid,
-            [{"op": "setxattr", "oid": oid, "name": name}], value)
+        p, _ = await self._submit(
+            oid, [{"op": "setxattr", "oid": oid, "name": name}], value)
         return p
 
     async def getxattr(self, oid: str, name: str) -> bytes:
@@ -306,16 +398,14 @@ class IoCtx:
                 for k, v in p["results"][0]["out"]["xattrs"].items()}
 
     async def rmxattr(self, oid: str, name: str) -> dict:
-        p, _ = await self.client.submit(
-            self.pool_name, oid,
-            [{"op": "rmxattr", "oid": oid, "name": name}])
+        p, _ = await self._submit(
+            oid, [{"op": "rmxattr", "oid": oid, "name": name}])
         return p
 
     async def omap_set(self, oid: str, kv: dict[str, bytes]) -> dict:
-        p, _ = await self.client.submit(
-            self.pool_name, oid,
-            [{"op": "omap_set", "oid": oid,
-              "kv": {k: v.decode("latin1") for k, v in kv.items()}}])
+        p, _ = await self._submit(
+            oid, [{"op": "omap_set", "oid": oid,
+                   "kv": {k: v.decode("latin1") for k, v in kv.items()}}])
         return p
 
     async def omap_get(self, oid: str) -> dict[str, bytes]:
@@ -325,19 +415,17 @@ class IoCtx:
                 for k, v in p["results"][0]["out"]["omap"].items()}
 
     async def omap_rm(self, oid: str, keys: list[str]) -> dict:
-        p, _ = await self.client.submit(
-            self.pool_name, oid,
-            [{"op": "omap_rm", "oid": oid, "keys": keys}])
+        p, _ = await self._submit(
+            oid, [{"op": "omap_rm", "oid": oid, "keys": keys}])
         return p
 
     async def call(self, oid: str, cls: str, method: str,
                    indata: bytes = b"") -> bytes:
         """Execute an object-class method server-side
         (rados_exec / CEPH_OSD_OP_CALL)."""
-        _, out = await self.client.submit(
-            self.pool_name, oid,
-            [{"op": "call", "oid": oid, "cls": cls, "method": method}],
-            indata)
+        _, out = await self._submit(
+            oid, [{"op": "call", "oid": oid, "cls": cls,
+                   "method": method}], indata)
         return out
 
     async def list_objects(self) -> list[str]:
