@@ -127,6 +127,18 @@ MOSDECSubOpWriteReply = _simple(0x71, "MOSDECSubOpWriteReply")
 MOSDECSubOpRead = _simple(0x72, "MOSDECSubOpRead")
 MOSDECSubOpReadReply = _simple(0x73, "MOSDECSubOpReadReply")
 
+# -- watch/notify (MWatchNotify, src/messages/MWatchNotify.h) ----------------
+MWatchNotify = _simple(0x90, "MWatchNotify")        # osd -> watcher client:
+                                                    # {"oid", "notify_id",
+                                                    #  "cookie"}; notifier
+                                                    # payload rides data
+MWatchNotifyAck = _simple(0x91, "MWatchNotifyAck")  # watcher -> osd on the
+                                                    # SAME conn (bypasses the
+                                                    # op queue: an ack queued
+                                                    # behind the blocking
+                                                    # notify would deadlock
+                                                    # its shard)
+
 # -- scrub (MOSDRepScrub / replica scrub map, src/messages/MOSDRepScrub.h) ---
 MOSDRepScrub = _simple(0x80, "MOSDRepScrub")        # {"pgid", "tid", "from",
                                                     #  "deep": bool}

@@ -157,6 +157,9 @@ class OSD(Dispatcher):
         # (the reference requeues at the front for the same reason)
         self._waiting_for_active: dict[PG, list] = {}
         self._op_seq = 0
+        # strong refs to detached notify tasks (the loop keeps only
+        # weak refs; a collected task would drop the notify silently)
+        self._notify_tasks: set[asyncio.Task] = set()
         # host-wide recovery throttle: background pushes across ALL PGs
         # share these slots so backfill cannot monopolize the daemon
         # (AsyncReserver, src/common/AsyncReserver.h)
@@ -442,6 +445,12 @@ class OSD(Dispatcher):
 
     # -- dispatch ------------------------------------------------------------
 
+    def ms_handle_reset(self, conn: Connection) -> None:
+        """A client connection died: its watches die with it (watchers
+        linger-re-register over a fresh connection)."""
+        for pg in self.pgs.values():
+            pg.drop_watchers_for_conn(conn)
+
     async def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
         if isinstance(msg, MPing):
             # the reply must name the RESPONDER: the pinger keys its
@@ -505,6 +514,12 @@ class OSD(Dispatcher):
             if pg is not None:
                 pg.handle_scrub_map(msg)
             return True
+        from ceph_tpu.msg.messages import MWatchNotifyAck
+        if isinstance(msg, MWatchNotifyAck):
+            pg = self._pg_of(msg)
+            if pg is not None:
+                pg.handle_notify_ack(msg)
+            return True
         return await self._dispatch_backend(conn, msg)
 
     async def _dispatch_backend(self, conn: Connection,
@@ -560,6 +575,30 @@ class OSD(Dispatcher):
         desc = (f"osd_op({'+'.join(o.get('op', '?') for o in ops)} "
                 f"{ops[0].get('oid', '') if ops else ''} "
                 f"pg={pgid.pool}.{pgid.ps} tid={p.get('tid', 0)})")
+        if any(o.get("op") == "notify" for o in ops):
+            # notify gathers watcher acks for seconds: it must NOT hold
+            # an op-queue shard, or a watcher callback touching the same
+            # PG (the RBD header-watch pattern) deadlocks behind it —
+            # the reference routes notifies outside the write pipeline.
+            # Still tracked + counted like any other op.
+            trk = self.optracker.create(desc)
+            trk.mark_event("detached_notify")
+
+            async def run_notify():
+                token = set_current_op(trk)
+                t0 = time.monotonic()
+                try:
+                    await self._handle_op(conn, msg)
+                finally:
+                    reset_current_op(token)
+                    trk.finish()
+                    self.perf.inc("op")
+                    self.perf.avg_add("op_latency",
+                                      time.monotonic() - t0)
+            t = asyncio.get_running_loop().create_task(run_notify())
+            self._notify_tasks.add(t)
+            t.add_done_callback(self._notify_tasks.discard)
+            return
         trk = self.optracker.create(desc)
         trk.mark_event("queued")
         self._op_seq += 1
@@ -638,7 +677,7 @@ class OSD(Dispatcher):
                     # one dedup key per op within the message: multi-op
                     # messages must not collide in the dup index
                     op = dict(op, reqid=[*p["reqid"], i])
-                rc, out, opdata = await pg.do_op(op, msg.data)
+                rc, out, opdata = await pg.do_op(op, msg.data, conn=conn)
                 results.append({"rc": rc, "out": out})
                 outdata += opdata
                 if rc < 0:

@@ -88,6 +88,12 @@ class PGInstance:
         # snaps this primary has finished trimming (persisted in meta)
         self.purged_snaps: set[int] = set()
         self._snaptrim_task: asyncio.Task | None = None
+        # watch/notify (primary, in-memory: clients linger-re-register
+        # across primary changes): oid -> cookie -> watcher record
+        self.watchers: dict[str, dict[int, dict]] = {}
+        self._notify_seq = 0
+        # notify_id -> {"pending": set[cookie], "acks": [...], "fut": ...}
+        self._notifies: dict[int, dict] = {}
         if pool.type == "erasure":
             from ceph_tpu.osd.ec_backend import ECBackend
             self.backend = ECBackend(self)
@@ -755,7 +761,8 @@ class PGInstance:
                                 "omap_vals", "getxattr", "getxattrs",
                                 "rollback", "snaptrim", "list_snaps"})
 
-    async def do_op(self, op: dict, data: bytes) -> tuple[int, dict, bytes]:
+    async def do_op(self, op: dict, data: bytes,
+                    conn=None) -> tuple[int, dict, bytes]:
         """Execute one client op; returns (rc, out, outdata) — the
         do_osd_ops dispatch table (src/osd/PrimaryLogPG.cc:5989)."""
         if not self._active_event.is_set():
@@ -841,9 +848,107 @@ class PGInstance:
                                 for k, v in omap.items()}}, b""
         if kind == "call":
             return await self._do_call(oid, op, data)
+        if kind in ("watch", "unwatch", "notify", "list_watchers"):
+            return await self._do_watch_op(kind, oid, op, data, conn)
         if kind == "list":
             return 0, {"objects": self.list_objects()}, b""
         return -22, {"error": f"unknown op {kind!r}"}, b""
+
+    # -- watch/notify (primary, src/osd/Watch.h + PrimaryLogPG
+    # do_osd_ops WATCH/NOTIFY/NOTIFY_ACK; divergence: watcher state is
+    # in-memory on the primary — clients linger-re-register across
+    # primary changes instead of the reference's persisted obc watchers)
+
+    async def _do_watch_op(self, kind: str, oid: str, op: dict,
+                           data: bytes, conn) -> tuple[int, dict, bytes]:
+        from ceph_tpu.msg.messages import MWatchNotify
+        if kind == "watch":
+            if not await self.backend.object_exists(oid):
+                return -2, {"error": "ENOENT"}, b""
+            if conn is None:
+                return -22, {"error": "watch needs a connection"}, b""
+            self.watchers.setdefault(oid, {})[int(op["cookie"])] = {
+                "conn": conn, "peer": getattr(conn, "peer_addr", None)}
+            return 0, {}, b""
+        if kind == "unwatch":
+            ws = self.watchers.get(oid, {})
+            ws.pop(int(op["cookie"]), None)
+            self._abandon_watcher(int(op["cookie"]))
+            if not ws:
+                self.watchers.pop(oid, None)
+            return 0, {}, b""
+        if kind == "list_watchers":
+            ws = self.watchers.get(oid, {})
+            return 0, {"watchers": [
+                {"cookie": c, "peer": list(w["peer"]) if w["peer"]
+                 else None} for c, w in sorted(ws.items())]}, b""
+        # notify: fan out to every live watcher, gather acks until all
+        # answer or the (bounded) timeout passes; dead connections are
+        # dropped immediately rather than waited out
+        self._notify_seq += 1
+        notify_id = self._notify_seq
+        ws = self.watchers.get(oid, {})
+        stale = [c for c, w in ws.items() if w["conn"]._closed]
+        for c in stale:
+            ws.pop(c, None)
+        pending = set(ws)
+        if not pending:
+            return 0, {"notify_id": notify_id, "acks": [],
+                       "timeouts": []}, b""
+        fut = asyncio.get_running_loop().create_future()
+        st = {"pending": pending, "acks": [], "dead": [], "fut": fut}
+        self._notifies[notify_id] = st
+        try:
+            for cookie, w in list(ws.items()):
+                w["conn"].send_message(MWatchNotify(
+                    {"oid": oid, "notify_id": notify_id,
+                     "cookie": cookie,
+                     "pgid": [self.pgid.pool, self.pgid.ps]}, data))
+            timeout = min(float(op.get("timeout", 3.0)), 30.0)
+            try:
+                await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                pass
+            return 0, {"notify_id": notify_id, "acks": st["acks"],
+                       "timeouts": sorted(set(st["pending"])
+                                          | set(st["dead"]))}, b""
+        finally:
+            self._notifies.pop(notify_id, None)
+
+    def handle_notify_ack(self, msg) -> None:
+        """MWatchNotifyAck from a watcher (arrives on its own
+        connection, outside the op queue)."""
+        p = msg.payload
+        n = self._notifies.get(int(p["notify_id"]))
+        if n is None:
+            return
+        cookie = int(p["cookie"])
+        if cookie in n["pending"]:
+            n["pending"].discard(cookie)
+            n["acks"].append([cookie, msg.data.decode("latin1")])
+            if not n["pending"] and not n["fut"].done():
+                n["fut"].set_result(None)
+
+    def _abandon_watcher(self, cookie: int) -> None:
+        """A watcher died or unwatched: any in-flight notify gather must
+        stop waiting for it NOW, not at its timeout."""
+        for st in self._notifies.values():
+            if cookie in st["pending"]:
+                st["pending"].discard(cookie)
+                st["dead"].append(cookie)
+                if not st["pending"] and not st["fut"].done():
+                    st["fut"].set_result(None)
+
+    def drop_watchers_for_conn(self, conn) -> None:
+        """Connection reset: its watches die with it (the reference's
+        watch timeout/disconnect handling)."""
+        for oid in list(self.watchers):
+            ws = self.watchers[oid]
+            for cookie in [c for c, w in ws.items() if w["conn"] is conn]:
+                ws.pop(cookie, None)
+                self._abandon_watcher(cookie)
+            if not ws:
+                self.watchers.pop(oid, None)
 
     async def _do_call(self, oid: str, op: dict,
                        data: bytes) -> tuple[int, dict, bytes]:

@@ -20,7 +20,8 @@ import time
 
 from ceph_tpu.crush.crush import CRUSH_NONE
 from ceph_tpu.crush.osdmap import Incremental, OSDMap
-from ceph_tpu.msg.messages import Message, MOSDOp, MOSDOpReply
+from ceph_tpu.msg.messages import (Message, MOSDOp, MOSDOpReply,
+                                   MWatchNotify, MWatchNotifyAck)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
 from ceph_tpu.mon.mon_client import MonClient
 from ceph_tpu.utils.dout import dout
@@ -61,6 +62,16 @@ class RadosClient(Dispatcher):
         self._reqseq = 0
         self._waiters: dict[int, asyncio.Future] = {}
         self._osd_conns: dict[int, Connection] = {}
+        # linger watches (Objecter linger ops): cookie -> registration;
+        # re-sent on map change / connection reset so a watch survives
+        # primary failover
+        self._watches: dict[int, dict] = {}
+        self._next_cookie = 1
+        self._relinger_task: asyncio.Task | None = None
+        self._relinger_pending = False
+        # strong refs: the loop keeps only weak refs to tasks, and a
+        # collected delivery task would silently swallow a notify
+        self._notify_tasks: set[asyncio.Task] = set()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -88,6 +99,7 @@ class RadosClient(Dispatcher):
                 self.osdmap.apply_incremental(inc)
         self.monc.sub_got("osdmap", self.osdmap.epoch)
         self._map_changed.set()
+        self._schedule_relinger()
 
     async def wait_for_map(self, min_epoch: int = 1,
                            timeout: float = 15.0) -> None:
@@ -137,7 +149,9 @@ class RadosClient(Dispatcher):
 
     async def submit(self, pool_name: str, oid: str, ops: list[dict],
                      data: bytes = b"", timeout: float | None = None,
-                     pgid=None) -> tuple[dict, bytes]:
+                     pgid=None,
+                     attempt_timeout: float | None = None
+                     ) -> tuple[dict, bytes]:
         """Objecter::op_submit-lite: compute the target, send, resend on
         epoch change / wrong-primary / transport fault. `pgid` pins the
         target PG (PG-scoped ops like `list`)."""
@@ -174,7 +188,7 @@ class RadosClient(Dispatcher):
                  "epoch": self.osdmap.epoch}, data))
             try:
                 reply = await asyncio.wait_for(
-                    fut, min(self.ATTEMPT_TIMEOUT,
+                    fut, min(attempt_timeout or self.ATTEMPT_TIMEOUT,
                              max(0.1, deadline - time.monotonic())))
             except asyncio.TimeoutError:
                 last = f"op timeout against osd.{primary}"
@@ -210,6 +224,75 @@ class RadosClient(Dispatcher):
         except (asyncio.TimeoutError, ConnectionError):
             pass
 
+    # -- watch/notify linger plumbing ----------------------------------------
+
+    def register_watch(self, pool: str, oid: str, callback) -> int:
+        # the OSD keys watchers by cookie alone (the reference keys by
+        # (entity, cookie)): embed the client nonce so two clients'
+        # cookies can never collide; a wide shift so the sequence can
+        # never carry into the nonce bits
+        cookie = self._nonce * 2 ** 32 + self._next_cookie
+        self._next_cookie += 1
+        self._watches[cookie] = {"pool": pool, "oid": oid,
+                                 "callback": callback}
+        return cookie
+
+    def unregister_watch(self, cookie: int) -> None:
+        self._watches.pop(cookie, None)
+
+    def _schedule_relinger(self) -> None:
+        if not self._watches:
+            return
+        # a reset arriving while a relinger pass is mid-flight must
+        # trigger ANOTHER pass: the running one may already be past the
+        # watch the new reset just killed
+        self._relinger_pending = True
+        if self._relinger_task is not None and \
+                not self._relinger_task.done():
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        self._relinger_task = loop.create_task(self._relinger())
+
+    async def _relinger(self) -> None:
+        """Re-send every registered watch (idempotent on the OSD): runs
+        after a map change or transport reset, so a watch follows the
+        PG's primary (Objecter::_linger_submit semantics)."""
+        while self._relinger_pending:
+            self._relinger_pending = False
+            await asyncio.sleep(0.05)
+            for cookie, w in list(self._watches.items()):
+                try:
+                    await self.submit(w["pool"], w["oid"],
+                                      [{"op": "watch", "oid": w["oid"],
+                                        "cookie": cookie}])
+                except Exception as e:
+                    dout("rados", 3, f"relinger watch {cookie} on "
+                                     f"{w['oid']!r}: {type(e).__name__} {e}")
+
+    async def _deliver_notify(self, conn: Connection,
+                              msg: Message) -> None:
+        p = msg.payload
+        w = self._watches.get(int(p.get("cookie", 0)))
+        ack = b""
+        if w is not None:
+            try:
+                res = w["callback"](p["notify_id"], msg.data)
+                if asyncio.iscoroutine(res):
+                    res = await res
+                if isinstance(res, bytes):
+                    ack = res
+            except Exception as e:
+                dout("rados", 2, f"watch callback failed: "
+                                 f"{type(e).__name__} {e}")
+        # ack on the SAME connection the notify came in on: it reaches
+        # the waiting primary without re-entering the op queue
+        conn.send_message(MWatchNotifyAck(
+            {"pgid": p["pgid"], "notify_id": p["notify_id"],
+             "cookie": p["cookie"]}, ack))
+
     # -- dispatch ------------------------------------------------------------
 
     async def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
@@ -218,12 +301,20 @@ class RadosClient(Dispatcher):
             if fut is not None and not fut.done():
                 fut.set_result((msg.payload, msg.data))
             return True
+        if isinstance(msg, MWatchNotify):
+            t = asyncio.get_running_loop().create_task(
+                self._deliver_notify(conn, msg))
+            self._notify_tasks.add(t)
+            t.add_done_callback(self._notify_tasks.discard)
+            return True
         return False
 
     def ms_handle_reset(self, conn: Connection) -> None:
         for osd, c in list(self._osd_conns.items()):
             if c is conn:
                 del self._osd_conns[osd]
+        # the primary holding our watches died with that conn
+        self._schedule_relinger()
 
 
 class IoCtx:
@@ -418,6 +509,51 @@ class IoCtx:
         p, _ = await self._submit(
             oid, [{"op": "omap_rm", "oid": oid, "keys": keys}])
         return p
+
+    # -- watch/notify (rados_watch3 / rados_notify2 subset) ------------------
+
+    async def watch(self, oid: str, callback) -> int:
+        """Register a watch; `callback(notify_id, data)` runs on every
+        notify (may be sync or async; bytes it returns ride the ack).
+        Returns the watch cookie. The client lingers the watch across
+        primary failover and reconnects."""
+        cookie = self.client.register_watch(self.pool_name, oid, callback)
+        try:
+            await self.client.submit(
+                self.pool_name, oid,
+                [{"op": "watch", "oid": oid, "cookie": cookie}])
+        except Exception:
+            self.client.unregister_watch(cookie)
+            raise
+        return cookie
+
+    async def unwatch(self, cookie: int) -> None:
+        w = self.client._watches.get(cookie)
+        self.client.unregister_watch(cookie)
+        if w is not None:
+            await self.client.submit(
+                self.pool_name, w["oid"],
+                [{"op": "unwatch", "oid": w["oid"], "cookie": cookie}])
+
+    async def notify(self, oid: str, payload: bytes = b"",
+                     timeout: float = 3.0) -> dict:
+        """Fan a notification out to every watcher of `oid`; returns
+        {"acks": [[cookie, data], ...], "timeouts": [cookie, ...]}.
+        The attempt window extends past the server-side gather so a slow
+        watcher can't make the Objecter resend (and double-notify)."""
+        p, _ = await self.client.submit(
+            self.pool_name, oid,
+            [{"op": "notify", "oid": oid, "timeout": timeout}], payload,
+            timeout=timeout + 10.0, attempt_timeout=timeout + 5.0)
+        out = p["results"][0]["out"]
+        return {"notify_id": out["notify_id"],
+                "acks": [[c, d.encode("latin1")] for c, d in out["acks"]],
+                "timeouts": list(out["timeouts"])}
+
+    async def list_watchers(self, oid: str) -> list[dict]:
+        p, _ = await self.client.submit(
+            self.pool_name, oid, [{"op": "list_watchers", "oid": oid}])
+        return p["results"][0]["out"]["watchers"]
 
     async def call(self, oid: str, cls: str, method: str,
                    indata: bytes = b"") -> bytes:
