@@ -1,0 +1,6 @@
+"""KeyValueDB layer (src/kv/): engine contract + MemDB + LSMStore."""
+from ceph_tpu.kv.keyvaluedb import KeyValueDB, KVTransaction, MemDB
+from ceph_tpu.kv.lsm import LSMStore, SimulatedCrash as KVSimulatedCrash
+
+__all__ = ["KeyValueDB", "KVTransaction", "MemDB", "LSMStore",
+           "KVSimulatedCrash"]

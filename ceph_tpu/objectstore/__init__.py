@@ -1,11 +1,13 @@
-"""Local object stores: ObjectStore API, MemStore (test double), and
-FileStore (persistent: WAL + crc-verified blobs + checkpointed meta)."""
+"""Local object stores: ObjectStore API, MemStore (test double),
+FileStore (WAL + crc-verified blobs + checkpointed meta), and BlueStore
+(block file + bitmap allocator + KeyValueDB metadata + per-extent crc)."""
 from ceph_tpu.objectstore.types import Ghobject, CollectionId
 from ceph_tpu.objectstore.store import (ObjectStore, StoreError, Transaction,
                                         NO_SHARD)
 from ceph_tpu.objectstore.memstore import MemStore
 from ceph_tpu.objectstore.filestore import FileStore, SimulatedCrash
+from ceph_tpu.objectstore.bluestore import BlueStore
 
 __all__ = ["Ghobject", "CollectionId", "ObjectStore", "StoreError",
-           "Transaction", "MemStore", "FileStore", "SimulatedCrash",
-           "NO_SHARD"]
+           "Transaction", "MemStore", "FileStore", "BlueStore",
+           "SimulatedCrash", "NO_SHARD"]

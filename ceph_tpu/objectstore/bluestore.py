@@ -1,0 +1,572 @@
+"""BlueStore-lite: ObjectStore on a raw block file + KeyValueDB metadata.
+
+Re-creation of the reference BlueStore's architecture
+(src/os/bluestore/BlueStore.cc) at framework scope:
+
+  * one flat block file is the "raw device"; a bitmap allocator hands
+    out 4 KiB allocation units (src/os/bluestore/BitmapAllocator) and
+    its state persists through the same KV batch as the metadata it
+    serves (FreelistManager);
+  * per-object metadata is an onode in the KV store (onode -> extent
+    map -> blobs, BlueStore.cc _do_write/_do_alloc_write :16792,:16184):
+    logical extents name (physical offset, length, crc32c), and every
+    read verifies the stored csum and raises EIO on mismatch
+    (bluestore_blob_t::verify_csum, bluestore_types.cc:840, read-time
+    check BlueStore.cc:12234);
+  * small objects are DEFERRED: their bytes live inline in the onode's
+    KV value and never touch the block file (the deferred-write WAL
+    role, BlueStore.cc :14191 _kv_sync_thread) — one fsync'd KV batch
+    is the whole commit;
+  * large writes go data-first: extents are written + fsync'd to the
+    block file BEFORE the KV batch commits, so a crash in between
+    leaves the old onode pointing at the old extents (BlueStore's txc
+    ordering); freed extents return to the allocator only after the
+    batch is durable;
+  * transactions map 1:1 onto an atomic KV batch (the RocksDB
+    WriteBatch role): apply is all-or-nothing at the KV WAL.
+
+Idiomatic divergences: writes rewrite the object's extent set rather
+than splicing sub-extents (the RMW/compression/blob-reuse machinery is
+out of scope); collections/omap/attrs are KV prefixes C/M plus fields
+in the onode record.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from ceph_tpu.kv.keyvaluedb import KeyValueDB, KVTransaction
+from ceph_tpu.kv.lsm import LSMStore
+from ceph_tpu.objectstore.store import (ObjectStore, Op, StoreError,
+                                        Transaction)
+from ceph_tpu.objectstore.types import (CollectionId, Ghobject, cid_from,
+                                        cid_key, oid_from, oid_key)
+from ceph_tpu.utils.crash import SimulatedCrash  # noqa: F401 (re-export)
+
+AU = 4096                    # allocation unit (min_alloc_size)
+INLINE_MAX = 64 * 1024       # deferred/inline object size ceiling
+
+# KV prefixes (the reference's column families, BlueStore.cc PREFIX_*)
+P_SUPER = "S"
+P_COLL = "C"
+P_ONODE = "O"
+P_OMAP = "M"
+
+
+def _crc32c(data: bytes) -> int:
+    from ceph_tpu.native import ec_native
+    return ec_native.crc32c(data)
+
+
+def _cid_key(cid: CollectionId) -> str:
+    return json.dumps(cid_key(cid))
+
+
+def _cid_from(key: str) -> CollectionId:
+    return cid_from(json.loads(key))
+
+
+def _oid_key(oid: Ghobject) -> str:
+    return json.dumps(oid_key(oid))
+
+
+def _oid_from(key: str) -> Ghobject:
+    return oid_from(json.loads(key))
+
+
+def _onode_key(cid: CollectionId, oid: Ghobject) -> str:
+    return _cid_key(cid) + "\x01" + _oid_key(oid)
+
+
+class BitmapAllocator:
+    """AU-granular bitmap over the block file (BitmapAllocator +
+    FreelistManager: the bitmap itself rides the commit batch)."""
+
+    def __init__(self, n_units: int = 0):
+        self.bits = bytearray(n_units)        # 0 free, 1 used
+        self._cursor = 0
+
+    def to_bytes(self) -> bytes:
+        return bytes(self.bits)
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "BitmapAllocator":
+        a = cls()
+        a.bits = bytearray(blob)
+        return a
+
+    def grow(self, n_units: int) -> None:
+        if n_units > len(self.bits):
+            self.bits.extend(b"\x00" * (n_units - len(self.bits)))
+
+    def allocate(self, n_units: int) -> list[tuple[int, int]]:
+        """Allocate `n_units`, possibly fragmented: [(unit, count)...].
+        Grows the device when free space runs out."""
+        out: list[tuple[int, int]] = []
+        need = n_units
+        scanned = 0
+        i = self._cursor
+        total = len(self.bits)
+        while need and scanned < total:
+            if i >= total:
+                i = 0
+            if not self.bits[i]:
+                j = i
+                while j < total and not self.bits[j] and (j - i) < need:
+                    j += 1
+                for k in range(i, j):
+                    self.bits[k] = 1
+                out.append((i, j - i))
+                need -= j - i
+                scanned += j - i
+                i = j
+            else:
+                i += 1
+                scanned += 1
+        if need:
+            base = len(self.bits)
+            self.grow(base + need)
+            for k in range(base, base + need):
+                self.bits[k] = 1
+            out.append((base, need))
+        self._cursor = i
+        return out
+
+    def free(self, extents: list[tuple[int, int]]) -> None:
+        for unit, count in extents:
+            for k in range(unit, unit + count):
+                self.bits[k] = 0
+
+
+class BlueStore(ObjectStore):
+
+    def __init__(self, path: str, kv: KeyValueDB | None = None):
+        self.path = path
+        self.kv = kv if kv is not None else LSMStore(
+            os.path.join(path, "db"))
+        self._block = None
+        self.alloc = BitmapAllocator()
+        # test hook: crash after block-file data writes, before the KV
+        # batch commit (the txc window the ordering protects)
+        self.fail_before_kv = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def mkfs(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        blk = os.path.join(self.path, "block")
+        if not os.path.exists(blk):
+            with open(blk, "wb"):
+                pass
+
+    def mount(self) -> None:
+        self.mkfs()
+        self.kv.open()
+        self._block = open(os.path.join(self.path, "block"), "r+b")
+        blob = self.kv.get(P_SUPER, "freelist")
+        self.alloc = BitmapAllocator.from_bytes(blob) if blob \
+            else BitmapAllocator()
+
+    def umount(self) -> None:
+        if self._block is not None:
+            self._block.close()
+            self._block = None
+        self.kv.close()
+
+    # -- onode helpers -------------------------------------------------------
+
+    def _onode(self, cid: CollectionId, oid: Ghobject) -> dict | None:
+        blob = self.kv.get(P_ONODE, _onode_key(cid, oid))
+        return None if blob is None else json.loads(blob)
+
+    def _require_coll(self, cid: CollectionId,
+                      ctx: "_TxnCtx | None" = None) -> None:
+        if ctx is not None and _cid_key(cid) in ctx.new_colls:
+            return
+        if self.kv.get(P_COLL, _cid_key(cid)) is None:
+            raise StoreError("ENOENT", f"no collection {cid}")
+
+    def _require_onode(self, cid: CollectionId, oid: Ghobject) -> dict:
+        on = self._onode(cid, oid)
+        if on is None:
+            raise StoreError("ENOENT", f"no object {oid} in {cid}")
+        return on
+
+    # -- data path -----------------------------------------------------------
+
+    def _read_extents(self, on: dict) -> bytes:
+        if "inline" in on:
+            return on["inline"].encode("latin1")
+        out = bytearray()
+        for unit, count, crc in on["extents"]:
+            self._block.seek(unit * AU)
+            chunk = self._block.read(count * AU)
+            if _crc32c(chunk) != crc:
+                raise StoreError("EIO",
+                                 f"csum mismatch at unit {unit}")
+            out.extend(chunk)
+        return bytes(out[:on["size"]])
+
+    def _stage_data(self, on: dict, data: bytes,
+                    ctx: "_TxnCtx") -> None:
+        """Replace the onode's data: inline when small, block extents
+        when large. Old extents are freed AFTER the batch commits."""
+        if "extents" in on:
+            ctx.free_after.extend((u, c) for u, c, _ in on["extents"])
+        on.pop("inline", None)
+        on.pop("extents", None)
+        on["size"] = len(data)
+        if len(data) <= INLINE_MAX:
+            on["inline"] = data.decode("latin1")
+            return
+        pad = (-len(data)) % AU
+        padded = data + b"\x00" * pad
+        units = len(padded) // AU
+        extents = []
+        off = 0
+        for unit, count in self.alloc.allocate(units):
+            ctx.allocated.append((unit, count))
+            chunk = padded[off:off + count * AU]
+            self._block.seek(unit * AU)
+            self._block.write(chunk)
+            extents.append([unit, count, _crc32c(chunk)])
+            off += count * AU
+        on["extents"] = extents
+        ctx.block_dirty = True
+
+    # -- transaction apply ---------------------------------------------------
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        ctx = _TxnCtx(self.kv.transaction())
+        # staged onode cache so multiple ops on one object in one txn
+        # compose before the single KV batch write
+        try:
+            for op in txn.ops:
+                self._apply_op(op, ctx)
+        except BaseException:
+            # all-or-nothing: nothing was committed, so units allocated
+            # by earlier ops of this txn must return to the allocator
+            self.alloc.free(ctx.allocated)
+            raise
+        for key, on in ctx.onodes.items():
+            if on is None:
+                ctx.batch.rmkey(P_ONODE, key)
+            else:
+                ctx.batch.set(P_ONODE, key, json.dumps(on).encode())
+        if ctx.block_dirty:
+            # data before metadata: the txc ordering (BlueStore.cc
+            # _txc_state_proc) — a crash here leaves old onodes valid
+            self._block.flush()
+            os.fsync(self._block.fileno())
+        if self.fail_before_kv:
+            self.alloc.free(ctx.allocated)
+            raise SimulatedCrash("crash between data write and KV commit")
+        # frees apply BEFORE the batch builds: every block write of this
+        # txn has already landed (on fresh units only), so the persisted
+        # bitmap can return the old extents atomically with the metadata
+        # that stopped referencing them (the FreelistManager role)
+        self.alloc.free(ctx.free_after)
+        if ctx.allocated or ctx.free_after:
+            ctx.batch.set(P_SUPER, "freelist", self.alloc.to_bytes())
+        try:
+            self.kv.submit_transaction(ctx.batch, sync=True)
+        except BaseException:
+            # restore the in-memory allocator to the durable state
+            self.alloc.free(ctx.allocated)
+            for unit, count in ctx.free_after:
+                for k in range(unit, unit + count):
+                    self.alloc.bits[k] = 1
+            raise
+        for fn in txn.on_applied:
+            fn()
+        for fn in txn.on_commit:
+            fn()
+
+    def _staged(self, ctx: "_TxnCtx", cid: CollectionId,
+                oid: Ghobject) -> dict | None:
+        key = _onode_key(cid, oid)
+        if key in ctx.onodes:
+            return ctx.onodes[key]
+        return self._onode(cid, oid)
+
+    def _apply_op(self, op: tuple, ctx: "_TxnCtx") -> None:
+        kind = op[0]
+        if kind == Op.MKCOLL:
+            cid = op[1]
+            if self.kv.get(P_COLL, _cid_key(cid)) is not None \
+                    or _cid_key(cid) in ctx.new_colls:
+                raise StoreError("EEXIST", f"collection {cid} exists")
+            ctx.batch.set(P_COLL, _cid_key(cid), b"1")
+            ctx.new_colls.add(_cid_key(cid))
+            return
+        if kind == Op.RMCOLL:
+            cid = op[1]
+            self._require_coll(cid, ctx)
+            prefix = _cid_key(cid) + "\x01"
+            live = {_onode_key(cid, gh)
+                    for gh in self.collection_list(cid)}
+            for k, on in ctx.onodes.items():
+                if not k.startswith(prefix):
+                    continue
+                if on is None:
+                    live.discard(k)
+                else:
+                    live.add(k)          # created earlier in THIS txn
+            if live:
+                raise StoreError("ENOTEMPTY",
+                                 f"collection {cid} not empty")
+            ctx.batch.rmkey(P_COLL, _cid_key(cid))
+            return
+        cid, oid = op[1], op[2]
+        key = _onode_key(cid, oid)
+
+        if kind == Op.TOUCH:
+            self._require_coll(cid, ctx)
+            if self._staged(ctx, cid, oid) is None:
+                ctx.onodes[key] = {"size": 0, "inline": "", "attrs": {}}
+            return
+        if kind == Op.WRITE:
+            self._require_coll(cid, ctx)
+            offset, data = op[3], op[4]
+            on = self._staged(ctx, cid, oid) or \
+                {"size": 0, "inline": "", "attrs": {}}
+            cur = bytearray(self._read_staged(on))
+            if len(cur) < offset:
+                cur.extend(b"\x00" * (offset - len(cur)))
+            cur[offset:offset + len(data)] = data
+            self._stage_data(on, bytes(cur), ctx)
+            ctx.onodes[key] = on
+            return
+        if kind == Op.ZERO:
+            self._require_coll(cid, ctx)
+            offset, length = op[3], op[4]
+            on = self._staged(ctx, cid, oid) or \
+                {"size": 0, "inline": "", "attrs": {}}
+            cur = bytearray(self._read_staged(on))
+            if len(cur) < offset + length:
+                cur.extend(b"\x00" * (offset + length - len(cur)))
+            cur[offset:offset + length] = b"\x00" * length
+            self._stage_data(on, bytes(cur), ctx)
+            ctx.onodes[key] = on
+            return
+        if kind == Op.TRUNCATE:
+            self._require_coll(cid, ctx)
+            size = op[3]
+            on = self._staged(ctx, cid, oid) or \
+                {"size": 0, "inline": "", "attrs": {}}
+            cur = bytearray(self._read_staged(on))
+            if len(cur) < size:
+                cur.extend(b"\x00" * (size - len(cur)))
+            else:
+                del cur[size:]
+            self._stage_data(on, bytes(cur), ctx)
+            ctx.onodes[key] = on
+            return
+        if kind == Op.REMOVE:
+            on = self._require_staged(ctx, cid, oid)
+            if "extents" in on:
+                ctx.free_after.extend((u, c) for u, c, _ in on["extents"])
+            ctx.onodes[key] = None
+            ctx.batch.rmkeys_by_prefix(P_OMAP + "\x01" + key)
+            ctx.omap_over[key] = {"\x00CLEAR\x00": None}
+            return
+        if kind == Op.SETATTRS:
+            self._require_coll(cid, ctx)
+            on = self._staged(ctx, cid, oid) or \
+                {"size": 0, "inline": "", "attrs": {}}
+            on.setdefault("attrs", {}).update(
+                {k: v.decode("latin1") for k, v in op[3].items()})
+            ctx.onodes[key] = on
+            return
+        if kind == Op.RMATTR:
+            on = self._require_staged(ctx, cid, oid)
+            on.get("attrs", {}).pop(op[3], None)
+            ctx.onodes[key] = on
+            return
+        if kind == Op.CLONE:
+            src, dst = op[2], op[3]
+            son = self._staged(ctx, cid, src)
+            if son is None:
+                raise StoreError("ENOENT", f"no object {src}")
+            data = self._read_staged(son)
+            don = {"size": 0, "inline": "", "attrs":
+                   dict(son.get("attrs", {}))}
+            old = self._staged(ctx, cid, dst)
+            if old is not None and "extents" in old:
+                ctx.free_after.extend((u, c)
+                                      for u, c, _ in old["extents"])
+            self._stage_data(don, data, ctx)
+            ctx.onodes[_onode_key(cid, dst)] = don
+            # omap clones with the object (MemStore does the same)
+            okeys = dict(self._omap_staged(ctx, cid, src))
+            pre_dst = P_OMAP + "\x01" + _onode_key(cid, dst)
+            ctx.batch.rmkeys_by_prefix(pre_dst)
+            ctx.omap_over.setdefault(_onode_key(cid, dst),
+                                     {}).clear()
+            ctx.omap_over[_onode_key(cid, dst)] = dict(okeys)
+            for k, v in okeys.items():
+                ctx.batch.set(pre_dst, k, v)
+            return
+        if kind == Op.CLONE_RANGE:
+            src, dst, src_off, length, dst_off = (op[2], op[3], op[4],
+                                                  op[5], op[6])
+            son = self._staged(ctx, cid, src)
+            if son is None:
+                raise StoreError("ENOENT", f"no object {src}")
+            sdata = self._read_staged(son)[src_off:src_off + length]
+            don = self._staged(ctx, cid, dst) or \
+                {"size": 0, "inline": "", "attrs": {}}
+            cur = bytearray(self._read_staged(don))
+            if len(cur) < dst_off:
+                cur.extend(b"\x00" * (dst_off - len(cur)))
+            cur[dst_off:dst_off + len(sdata)] = sdata
+            self._stage_data(don, bytes(cur), ctx)
+            ctx.onodes[_onode_key(cid, dst)] = don
+            return
+        if kind == Op.COLL_MOVE_RENAME:
+            old_cid, old_oid, new_cid, new_oid = op[1], op[2], op[3], op[4]
+            on = self._staged(ctx, old_cid, old_oid)
+            if on is None:
+                raise StoreError("ENOENT", f"no object {old_oid}")
+            self._require_coll(new_cid, ctx)
+            okeys = dict(self._omap_staged(ctx, old_cid, old_oid))
+            ctx.onodes[_onode_key(old_cid, old_oid)] = None
+            ctx.batch.rmkeys_by_prefix(
+                P_OMAP + "\x01" + _onode_key(old_cid, old_oid))
+            ctx.onodes[_onode_key(new_cid, new_oid)] = on
+            pre = P_OMAP + "\x01" + _onode_key(new_cid, new_oid)
+            for k, v in okeys.items():
+                ctx.batch.set(pre, k, v)
+            ctx.omap_over[_onode_key(new_cid, new_oid)] = dict(okeys)
+            return
+        if kind == Op.OMAP_SETKEYS:
+            self._require_coll(cid, ctx)
+            on = self._staged(ctx, cid, oid) or \
+                {"size": 0, "inline": "", "attrs": {}}
+            ctx.onodes[key] = on
+            pre = P_OMAP + "\x01" + key
+            over = ctx.omap_over.setdefault(key, {})
+            for k, v in op[3].items():
+                ctx.batch.set(pre, k, v)
+                over[k] = v
+            return
+        if kind == Op.OMAP_RMKEYS:
+            self._require_coll(cid, ctx)
+            on = self._staged(ctx, cid, oid) or \
+                {"size": 0, "inline": "", "attrs": {}}
+            ctx.onodes[key] = on
+            pre = P_OMAP + "\x01" + key
+            over = ctx.omap_over.setdefault(key, {})
+            for k in op[3]:
+                ctx.batch.rmkey(pre, k)
+                over[k] = None
+            return
+        if kind == Op.OMAP_CLEAR:
+            ctx.batch.rmkeys_by_prefix(P_OMAP + "\x01" + key)
+            ctx.omap_over[key] = {"\x00CLEAR\x00": None}
+            return
+        raise StoreError("EINVAL", f"unknown op {kind}")
+
+    def _require_staged(self, ctx: "_TxnCtx", cid: CollectionId,
+                        oid: Ghobject) -> dict:
+        on = self._staged(ctx, cid, oid)
+        if on is None:
+            raise StoreError("ENOENT", f"no object {oid} in {cid}")
+        return on
+
+    def _read_staged(self, on: dict) -> bytes:
+        return self._read_extents(on)
+
+    def _omap_staged(self, ctx: "_TxnCtx", cid: CollectionId,
+                     oid: Ghobject) -> dict[str, bytes]:
+        key = _onode_key(cid, oid)
+        committed = self._onode(cid, oid) is not None
+        staged_off = key in ctx.onodes and ctx.onodes[key] is None
+        base = self.omap_get(cid, oid) \
+            if committed and not staged_off else {}
+        over = ctx.omap_over.get(key, {})
+        if "\x00CLEAR\x00" in over:
+            base = {}
+        for k, v in over.items():
+            if k == "\x00CLEAR\x00":
+                continue
+            if v is None:
+                base.pop(k, None)
+            else:
+                base[k] = v
+        return base
+
+    # -- reads ---------------------------------------------------------------
+
+    def list_collections(self) -> list[CollectionId]:
+        return sorted((_cid_from(k) for k, _ in self.kv.iterate(P_COLL)))
+
+    def collection_exists(self, cid: CollectionId) -> bool:
+        return self.kv.get(P_COLL, _cid_key(cid)) is not None
+
+    def collection_list(self, cid: CollectionId,
+                        start: Ghobject | None = None,
+                        max_count: int = 2 ** 31) -> list[Ghobject]:
+        prefix = _cid_key(cid) + "\x01"
+        out = []
+        for k, _ in self.kv.iterate(P_ONODE, start=prefix):
+            if not k.startswith(prefix):
+                break                    # keys are ordered: prefix done
+            out.append(_oid_from(k[len(prefix):]))
+        out.sort()
+        if start is not None:
+            out = [o for o in out if o > start]
+        return out[:max_count]
+
+    def exists(self, cid: CollectionId, oid: Ghobject) -> bool:
+        return self._onode(cid, oid) is not None
+
+    def stat(self, cid: CollectionId, oid: Ghobject) -> dict:
+        on = self._require_onode(cid, oid)
+        return {"size": on["size"]}
+
+    def read(self, cid: CollectionId, oid: Ghobject, offset: int = 0,
+             length: int | None = None) -> bytes:
+        on = self._require_onode(cid, oid)
+        data = self._read_extents(on)
+        if length is None:
+            return data[offset:]
+        return data[offset:offset + length]
+
+    def getattr(self, cid: CollectionId, oid: Ghobject,
+                name: str) -> bytes:
+        on = self._require_onode(cid, oid)
+        if name not in on.get("attrs", {}):
+            raise StoreError("ENODATA", f"no attr {name} on {oid}")
+        return on["attrs"][name].encode("latin1")
+
+    def getattrs(self, cid: CollectionId,
+                 oid: Ghobject) -> dict[str, bytes]:
+        on = self._require_onode(cid, oid)
+        return {k: v.encode("latin1")
+                for k, v in on.get("attrs", {}).items()}
+
+    def omap_get(self, cid: CollectionId,
+                 oid: Ghobject) -> dict[str, bytes]:
+        self._require_onode(cid, oid)
+        pre = P_OMAP + "\x01" + _onode_key(cid, oid)
+        return dict(self.kv.iterate(pre))
+
+    def omap_get_values(self, cid: CollectionId, oid: Ghobject,
+                        keys) -> dict[str, bytes]:
+        omap = self.omap_get(cid, oid)
+        return {k: omap[k] for k in keys if k in omap}
+
+
+class _TxnCtx:
+    """Per-transaction staging: onode edits + omap overlay + deferred
+    frees, folded into one KV batch at the end."""
+
+    def __init__(self, batch: KVTransaction):
+        self.batch = batch
+        self.onodes: dict[str, dict | None] = {}
+        self.new_colls: set[str] = set()
+        self.omap_over: dict[str, dict] = {}
+        self.free_after: list[tuple[int, int]] = []
+        self.allocated: list[tuple[int, int]] = []
+        self.block_dirty = False

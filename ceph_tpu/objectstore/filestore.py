@@ -31,12 +31,9 @@ import struct
 
 from ceph_tpu.objectstore.memstore import MemStore
 from ceph_tpu.objectstore.store import Op, StoreError, Transaction
-from ceph_tpu.objectstore.types import CollectionId, Ghobject
-
-
-class SimulatedCrash(Exception):
-    """Raised by the fail_after_wal test hook after the WAL record is
-    durable but before apply — the window BlueStore's replay covers."""
+from ceph_tpu.objectstore.types import (CollectionId, Ghobject, cid_from,
+                                        cid_key, oid_from, oid_key)
+from ceph_tpu.utils.crash import SimulatedCrash
 
 
 def _fsync_dir(path: str) -> None:
@@ -52,22 +49,8 @@ def _crc32c(data: bytes) -> int:
     return ec_native.crc32c(data)
 
 
-def _cid_key(cid: CollectionId) -> list:
-    return [cid.pool, cid.pg_seed, cid.shard, cid.meta]
-
-
-def _cid_from(key: list) -> CollectionId:
-    return CollectionId(pool=key[0], pg_seed=key[1], shard=key[2],
-                        meta=key[3])
-
-
-def _oid_key(oid: Ghobject) -> list:
-    return [oid.pool, oid.nspace, oid.name, oid.snap, oid.gen, oid.shard]
-
-
-def _oid_from(key: list) -> Ghobject:
-    return Ghobject(pool=key[0], nspace=key[1], name=key[2], snap=key[3],
-                    gen=key[4], shard=key[5])
+_cid_key, _cid_from = cid_key, cid_from
+_oid_key, _oid_from = oid_key, oid_from
 
 
 def _b2s(d: dict) -> dict:

@@ -46,6 +46,26 @@ class Ghobject:
         return ":".join(parts)
 
 
+def cid_key(cid: "CollectionId") -> list:
+    """JSON-stable identity list (shared by FileStore/BlueStore key
+    encodings — one codec so the stores can never disagree)."""
+    return [cid.pool, cid.pg_seed, cid.shard, cid.meta]
+
+
+def cid_from(key: list) -> "CollectionId":
+    return CollectionId(pool=key[0], pg_seed=key[1], shard=key[2],
+                        meta=key[3])
+
+
+def oid_key(oid: Ghobject) -> list:
+    return [oid.pool, oid.nspace, oid.name, oid.snap, oid.gen, oid.shard]
+
+
+def oid_from(key: list) -> Ghobject:
+    return Ghobject(pool=key[0], nspace=key[1], name=key[2], snap=key[3],
+                    gen=key[4], shard=key[5])
+
+
 @dataclasses.dataclass(frozen=True, order=True)
 class CollectionId:
     """Collection identity (coll_t): a PG shard or the meta collection."""

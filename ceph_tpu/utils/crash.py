@@ -1,0 +1,12 @@
+"""Shared crash-injection exception for durability tests.
+
+One class for every storage tier (FileStore WAL window, BlueStore txc
+window, LSM WAL window) so harness code can catch `SimulatedCrash` from
+the package it drives without knowing which layer raised it.
+"""
+
+
+class SimulatedCrash(Exception):
+    """Raised by a fail_* test hook at the exact point a real crash
+    would interrupt a commit; the durable state before the hook must
+    fully reconstruct on remount."""

@@ -10,15 +10,17 @@ import os
 import numpy as np
 import pytest
 
-from ceph_tpu.objectstore import (CollectionId, FileStore, Ghobject,
-                                  MemStore, SimulatedCrash, StoreError,
-                                  Transaction)
+from ceph_tpu.objectstore import (BlueStore, CollectionId, FileStore,
+                                  Ghobject, MemStore, SimulatedCrash,
+                                  StoreError, Transaction)
 
 
-@pytest.fixture(params=["memstore", "filestore"])
+@pytest.fixture(params=["memstore", "filestore", "bluestore"])
 def store(request, tmp_path):
     if request.param == "memstore":
         s = MemStore()
+    elif request.param == "bluestore":
+        s = BlueStore(str(tmp_path / "bs"))
     else:
         s = FileStore(str(tmp_path / "fs"))
     s.mkfs()

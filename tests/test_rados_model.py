@@ -48,11 +48,15 @@ async def _drive(c, cl, io, ec_pool, seed, n_ops, thrash=True,
     return runner, thrasher
 
 
-@pytest.mark.parametrize("backend", ["memstore", "filestore"])
+@pytest.mark.parametrize("backend", ["memstore", "filestore",
+                                     "bluestore"])
 def test_model_replicated_thrashed(tmp_path, backend):
-    from ceph_tpu.objectstore import FileStore
-    factory = (lambda i: FileStore(str(tmp_path / f"osd{i}"))) \
-        if backend == "filestore" else None
+    from ceph_tpu.objectstore import BlueStore, FileStore
+    factory = {"filestore":
+               (lambda i: FileStore(str(tmp_path / f"osd{i}"))),
+               "bluestore":
+               (lambda i: BlueStore(str(tmp_path / f"osd{i}"))),
+               "memstore": None}[backend]
 
     async def body():
         c = ClusterHarness(tmp_path, n_osds=3, store_factory=factory)
