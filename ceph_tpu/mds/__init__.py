@@ -1,0 +1,5 @@
+"""CephFS layer: MDS daemon (metadata in RADOS) + POSIX-ish client."""
+from ceph_tpu.mds.daemon import MDSDaemon
+from ceph_tpu.mds.client import CephFS, CephFSError, File
+
+__all__ = ["MDSDaemon", "CephFS", "CephFSError", "File"]

@@ -139,6 +139,12 @@ MWatchNotifyAck = _simple(0x91, "MWatchNotifyAck")  # watcher -> osd on the
                                                     # notify would deadlock
                                                     # its shard)
 
+# -- cephfs client<->mds (MClientRequest/MClientReply,
+# src/messages/MClientRequest.h) ---------------------------------------------
+MClientRequest = _simple(0xA0, "MClientRequest")    # {"tid", "op", "path",
+                                                    #  ...op args}
+MClientReply = _simple(0xA1, "MClientReply")        # {"tid", "rc", "out"}
+
 # -- scrub (MOSDRepScrub / replica scrub map, src/messages/MOSDRepScrub.h) ---
 MOSDRepScrub = _simple(0x80, "MOSDRepScrub")        # {"pgid", "tid", "from",
                                                     #  "deep": bool}

@@ -397,15 +397,17 @@ class BlueStore(ObjectStore):
                                       for u, c, _ in old["extents"])
             self._stage_data(don, data, ctx)
             ctx.onodes[_onode_key(cid, dst)] = don
-            # omap clones with the object (MemStore does the same)
+            # omap clones with the object (MemStore does the same);
+            # the CLEAR sentinel hides dst's committed keys from later
+            # same-txn readers (replace, never merge)
             okeys = dict(self._omap_staged(ctx, cid, src))
             pre_dst = P_OMAP + "\x01" + _onode_key(cid, dst)
             ctx.batch.rmkeys_by_prefix(pre_dst)
-            ctx.omap_over.setdefault(_onode_key(cid, dst),
-                                     {}).clear()
-            ctx.omap_over[_onode_key(cid, dst)] = dict(okeys)
+            over = {"\x00CLEAR\x00": None}
             for k, v in okeys.items():
                 ctx.batch.set(pre_dst, k, v)
+                over[k] = v
+            ctx.omap_over[_onode_key(cid, dst)] = over
             return
         if kind == Op.CLONE_RANGE:
             src, dst, src_off, length, dst_off = (op[2], op[3], op[4],
@@ -430,14 +432,24 @@ class BlueStore(ObjectStore):
                 raise StoreError("ENOENT", f"no object {old_oid}")
             self._require_coll(new_cid, ctx)
             okeys = dict(self._omap_staged(ctx, old_cid, old_oid))
+            dst_old = self._staged(ctx, new_cid, new_oid)
+            if dst_old is not None and "extents" in dst_old:
+                # replaced destination: its space must return
+                ctx.free_after.extend((u, c)
+                                      for u, c, _ in dst_old["extents"])
             ctx.onodes[_onode_key(old_cid, old_oid)] = None
             ctx.batch.rmkeys_by_prefix(
                 P_OMAP + "\x01" + _onode_key(old_cid, old_oid))
+            ctx.omap_over[_onode_key(old_cid, old_oid)] = \
+                {"\x00CLEAR\x00": None}
             ctx.onodes[_onode_key(new_cid, new_oid)] = on
             pre = P_OMAP + "\x01" + _onode_key(new_cid, new_oid)
+            ctx.batch.rmkeys_by_prefix(pre)    # replace, never merge
+            over = {"\x00CLEAR\x00": None}
             for k, v in okeys.items():
                 ctx.batch.set(pre, k, v)
-            ctx.omap_over[_onode_key(new_cid, new_oid)] = dict(okeys)
+                over[k] = v
+            ctx.omap_over[_onode_key(new_cid, new_oid)] = over
             return
         if kind == Op.OMAP_SETKEYS:
             self._require_coll(cid, ctx)

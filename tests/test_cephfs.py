@@ -1,0 +1,211 @@
+"""CephFS tests: namespace ops, striped file I/O, journal replay across
+MDS restart, multi-client visibility, purge on unlink.
+
+Models the reference's fs workunits / libcephfs tests
+(qa/workunits/fs/misc, src/test/libcephfs/test.cc) on the in-process
+cluster harness.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+
+import pytest
+
+from ceph_tpu.mds import CephFS, CephFSError, MDSDaemon
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+
+
+class FSHarness(ClusterHarness):
+    """Cluster + pools + one MDS rank."""
+
+    async def start_fs(self) -> MDSDaemon:
+        admin = await self.client()
+        await admin.pool_create("cephfs_metadata", pg_num=8, size=3)
+        await admin.pool_create("cephfs_data", pg_num=8, size=3)
+        self.mds = MDSDaemon(self.mon_addrs)
+        # small stripes so tests cross object boundaries cheaply
+        self.mds.stripe_unit = 4096
+        await self.mds.start()
+        return self.mds
+
+    async def mount(self) -> CephFS:
+        fs = CephFS(self.mon_addrs, self.mds.addr)
+        await fs.mount()
+        self.clients.append(fs.rados)
+        self._mounts = getattr(self, "_mounts", [])
+        self._mounts.append(fs)
+        return fs
+
+    async def stop(self) -> None:
+        for fs in getattr(self, "_mounts", []):
+            try:
+                await fs.messenger.shutdown()
+            except Exception:
+                pass
+        try:
+            await self.mds.stop()
+        except Exception:
+            pass
+        await super().stop()
+
+
+def test_namespace_and_file_io(tmp_path):
+    async def body():
+        c = FSHarness(tmp_path)
+        try:
+            await c.start()
+            await c.start_fs()
+            fs = await c.mount()
+
+            await fs.mkdir("/home")
+            await fs.mkdir("/home/user")
+            assert sorted(await fs.readdir("/")) == ["home"]
+            assert (await fs.stat("/home"))["type"] == "dir"
+
+            # file crossing several 4 KiB stripe objects
+            payload = os.urandom(3 * 4096 + 777)
+            await fs.write_file("/home/user/data.bin", payload)
+            assert await fs.read_file("/home/user/data.bin") == payload
+            st = await fs.stat("/home/user/data.bin")
+            assert st["size"] == len(payload)
+
+            # ranged read + overwrite in the middle
+            f = await fs.open("/home/user/data.bin", "a")
+            assert await f.read(100, offset=4000) == payload[4000:4100]
+            await f.write(b"PATCH", offset=5000)
+            await f.close()
+            expect = bytearray(payload)
+            expect[5000:5005] = b"PATCH"
+            assert await fs.read_file("/home/user/data.bin") == \
+                bytes(expect)
+
+            # append mode
+            f = await fs.open("/home/user/data.bin", "a")
+            await f.write(b"tail")
+            await f.close()
+            assert (await fs.read_file("/home/user/data.bin")
+                    )[-4:] == b"tail"
+
+            # rename + unlink + rmdir
+            await fs.rename("/home/user/data.bin", "/home/data2.bin")
+            assert not await fs.exists("/home/user/data.bin")
+            assert (await fs.stat("/home/data2.bin"))["size"] == \
+                len(payload) + 4
+            await fs.unlink("/home/data2.bin")
+            assert not await fs.exists("/home/data2.bin")
+            with pytest.raises(CephFSError) as ei:
+                await fs.rmdir("/home")          # not empty (user/)
+            assert ei.value.rc == -39
+            await fs.rmdir("/home/user")
+            await fs.rmdir("/home")
+            assert await fs.readdir("/") == {}
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_unlink_purges_data_objects(tmp_path):
+    async def body():
+        c = FSHarness(tmp_path)
+        try:
+            await c.start()
+            await c.start_fs()
+            fs = await c.mount()
+            await fs.write_file("/big", os.urandom(5 * 4096))
+            data = fs.rados.ioctx("cephfs_data")
+            assert len(await data.list_objects()) == 5
+            await fs.unlink("/big")
+            assert await data.list_objects() == []
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_mds_restart_replays_journal(tmp_path):
+    """Metadata survives an MDS restart (state is all in RADOS), and a
+    journaled-but-unapplied event replays."""
+    async def body():
+        c = FSHarness(tmp_path)
+        try:
+            await c.start()
+            await c.start_fs()
+            fs = await c.mount()
+            await fs.mkdir("/keep")
+            await fs.write_file("/keep/f.txt", b"persisted")
+
+            # journal an event WITHOUT applying it (simulated crash
+            # between MDLog append and the dirfrag write-through)
+            await c.mds._journal(
+                {"ev": "set_dentry", "dir": 1, "name": "ghost",
+                 "dentry": {"ino": 424242, "type": "file", "size": 0,
+                            "mtime": 0.0, "stripe": 4096}})
+            await c.mds.stop()
+
+            mds2 = MDSDaemon(c.mon_addrs)
+            mds2.stripe_unit = 4096
+            await mds2.start()
+            c.mds = mds2
+            fs2 = await c.mount()
+            assert await fs2.read_file("/keep/f.txt") == b"persisted"
+            # the journaled-only event was replayed at startup
+            assert await fs2.exists("/ghost")
+            entries = await fs2.readdir("/")
+            assert sorted(entries) == ["ghost", "keep"]
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_rename_edge_cases(tmp_path):
+    async def body():
+        c = FSHarness(tmp_path)
+        try:
+            await c.start()
+            await c.start_fs()
+            fs = await c.mount()
+            await fs.mkdir("/d")
+            await fs.write_file("/d/f", b"keep me")
+
+            # same-path rename is a POSIX no-op, never a delete
+            await fs.rename("/d/f", "/d/f")
+            assert await fs.read_file("/d/f") == b"keep me"
+
+            # a directory cannot move into its own subtree
+            await fs.mkdir("/d/sub")
+            with pytest.raises(CephFSError) as ei:
+                await fs.rename("/d", "/d/sub/d2")
+            assert ei.value.rc == -22
+            assert await fs.read_file("/d/f") == b"keep me"
+
+            # overwriting rename replaces the target and purges its data
+            await fs.write_file("/d/g", b"replaced")
+            await fs.rename("/d/f", "/d/g")
+            assert await fs.read_file("/d/g") == b"keep me"
+            assert not await fs.exists("/d/f")
+            data = fs.rados.ioctx("cephfs_data")
+            # only g's (former f's) single data object remains
+            assert len(await data.list_objects()) == 1
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_two_mounts_see_each_other(tmp_path):
+    async def body():
+        c = FSHarness(tmp_path)
+        try:
+            await c.start()
+            await c.start_fs()
+            fs1 = await c.mount()
+            fs2 = await c.mount()
+            await fs1.mkdir("/shared")
+            await fs1.write_file("/shared/note", b"from fs1")
+            assert await fs2.read_file("/shared/note") == b"from fs1"
+            await fs2.rename("/shared/note", "/shared/note2")
+            assert not await fs1.exists("/shared/note")
+            assert await fs1.read_file("/shared/note2") == b"from fs1"
+        finally:
+            await c.stop()
+    run(body())
