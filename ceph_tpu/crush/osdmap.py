@@ -305,3 +305,21 @@ class OSDMap:
         if d.get("crush") is not None:
             self.crush = CrushMap.from_dict(d["crush"])
         self.ec_profiles = dict(d.get("ec_profiles", {}))
+
+
+def apply_map_payload(osdmap: "OSDMap", payload: dict) -> bool:
+    """Apply a mon osdmap-subscription payload (full map and/or
+    incremental chain) to `osdmap` in place; returns True if the epoch
+    advanced. Shared by every map consumer (client/mgr/...) so the
+    update protocol lives in ONE place."""
+    import json as _json
+    before = osdmap.epoch
+    full = payload.get("full")
+    if full is not None and full["epoch"] > osdmap.epoch:
+        osdmap.load_dict(full)
+    for raw in payload.get("incrementals", []):
+        inc = Incremental.from_dict(
+            _json.loads(raw) if isinstance(raw, str) else raw)
+        if inc.epoch == osdmap.epoch + 1:
+            osdmap.apply_incremental(inc)
+    return osdmap.epoch > before

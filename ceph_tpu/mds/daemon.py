@@ -255,6 +255,13 @@ class MDSDaemon(Dispatcher):
             conn.send_message(MClientReply(
                 {"tid": p.get("tid", 0), "rc": -5,
                  "error": f"{type(e).__name__}: {e}"}))
+        except Exception as e:
+            # a malformed request or corrupt record must still ANSWER:
+            # a dropped exception would leave the client hanging its
+            # full request timeout (the monitor replies rc=-22 likewise)
+            conn.send_message(MClientReply(
+                {"tid": p.get("tid", 0), "rc": -22,
+                 "error": f"{type(e).__name__}: {e}"}))
         return True
 
     # -- operations (Server.cc handle_client_* subset) -----------------------
@@ -374,9 +381,7 @@ class MDSDaemon(Dispatcher):
             raise FSError(-21, "target is a directory")
         ev = {"ev": "rename", "src_dir": src_dir, "src_name": src_name,
               "dst_dir": dst_dir, "dst_name": dst_name, "dentry": dentry}
-        await self._journal(ev)
-        await self._apply_event(ev)
-        await self._trim_journal()
+        await self._journal_and_apply(ev)
         if target is not None:
             # purge the REPLACED file only after the rename is durable:
             # a crash before the journal append must leave /dst intact
@@ -390,12 +395,23 @@ class MDSDaemon(Dispatcher):
 
     async def _purge_file(self, dentry: dict) -> None:
         """Delete the file's data objects (the PurgeQueue role,
-        src/mds/PurgeQueue.cc — synchronous here)."""
-        stripe = dentry.get("stripe", self.stripe_unit)
-        n = max(1, -(-dentry.get("size", 0) // stripe))
-        for idx in range(n):
+        src/mds/PurgeQueue.cc — synchronous here). Purges by LISTING,
+        not by recorded size: a writer that crashed before its size
+        flush may have landed more stripe objects than the dentry
+        admits, and those must not leak (inos are never reused)."""
+        prefix = f"{dentry['ino']:x}."
+        try:
+            names = [o for o in await self.data.list_objects()
+                     if o.startswith(prefix)]
+        except Exception:
+            # listing unavailable: fall back to the recorded size
+            stripe = dentry.get("stripe", self.stripe_unit)
+            names = [data_oid(dentry["ino"], idx)
+                     for idx in range(
+                         max(1, -(-dentry.get("size", 0) // stripe)))]
+        for name in names:
             try:
-                await self.data.remove(data_oid(dentry["ino"], idx))
+                await self.data.remove(name)
             except ObjectNotFound:
                 pass
 

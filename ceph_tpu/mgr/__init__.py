@@ -6,5 +6,8 @@ its analog: an HTTP endpoint exposing every PerfCounters metric in the
 process plus cluster health, in the prometheus text format.
 """
 from ceph_tpu.mgr.exporter import MetricsExporter
+from ceph_tpu.mgr.daemon import (BalancerModule, MgrDaemon, MgrModule,
+                                 PGAutoscalerModule)
 
-__all__ = ["MetricsExporter"]
+__all__ = ["MetricsExporter", "MgrDaemon", "MgrModule",
+           "BalancerModule", "PGAutoscalerModule"]

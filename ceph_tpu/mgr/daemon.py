@@ -1,0 +1,236 @@
+"""Mgr daemon: cluster-state aggregation + hosted modules.
+
+Re-creation of the reference mgr's architecture (src/mgr/): a daemon
+that subscribes to cluster maps through a MonClient, aggregates health
+and per-daemon metrics, and hosts MODULES that receive cluster-state
+snapshots and act through mon commands (src/mgr/ActivePyModules.cc
+giving modules get('osd_map') + mon_command). The prometheus exporter
+(mgr/exporter.py) serves this daemon's view over HTTP.
+
+Modules shipped (src/pybind/mgr/ equivalents):
+  * balancer — upmap-lite: evens per-OSD PG counts by issuing
+    `osd pg-temp` overrides that swap the most-loaded OSD out of a PG's
+    acting set for the least-loaded one (the reference's upmap balancer
+    optimizes the same objective via pg-upmap-items,
+    src/pybind/mgr/balancer/module.py);
+  * pg_autoscaler — recommends pg_num per pool from OSD count and pool
+    size toward ~100 PGs/OSD (src/pybind/mgr/pg_autoscaler/module.py
+    _get_pool_status); report-only, like the autoscaler in warn mode.
+
+Idiomatic divergences: modules are plain Python objects ticked by the
+mgr loop (no CPython-embedding/Gil machinery needed — the whole daemon
+is Python); daemon metric aggregation reads the in-process
+PerfCountersCollection registry instead of MMgrReport messages.
+"""
+from __future__ import annotations
+
+import asyncio
+
+from ceph_tpu.crush.osdmap import Incremental, OSDMap, PG
+from ceph_tpu.mgr.exporter import MetricsExporter
+from ceph_tpu.mon.mon_client import MonClient
+from ceph_tpu.msg.messenger import Messenger
+from ceph_tpu.utils.dout import dout
+
+import json
+
+
+class MgrModule:
+    """Module contract: tick(mgr) runs every mgr interval."""
+
+    NAME = "module"
+
+    async def tick(self, mgr: "MgrDaemon") -> None:
+        raise NotImplementedError
+
+    def status(self) -> dict:
+        return {}
+
+
+class MgrDaemon:
+
+    TICK_INTERVAL = 1.0
+
+    def __init__(self, mon_addrs, modules: list[MgrModule] | None = None,
+                 auth_key: bytes | None = None,
+                 exporter_port: int | None = 0):
+        self.messenger = Messenger("mgr", auth_key=auth_key)
+        self.monc = MonClient(self.messenger, mon_addrs)
+        self.monc.on_osdmap = self._on_osdmap
+        self.osdmap = OSDMap()
+        self.modules = modules if modules is not None else \
+            [BalancerModule(), PGAutoscalerModule()]
+        self.health: dict = {}
+        self._tick_task: asyncio.Task | None = None
+        self.exporter: MetricsExporter | None = None
+        self._exporter_port = exporter_port
+
+    async def start(self) -> None:
+        await self.messenger.bind("127.0.0.1", 0)
+        await self.monc.start()
+        self.monc.subscribe("osdmap", 1)
+        if self._exporter_port is not None:
+            async def health_cb() -> dict:
+                return self.health
+            self.exporter = MetricsExporter(
+                port=self._exporter_port, health_cb=health_cb)
+            await self.exporter.start()
+        self._tick_task = asyncio.get_running_loop().create_task(
+            self._tick_loop())
+        dout("mgr", 1, "mgr up "
+             + (f"(metrics on {self.exporter.addr})"
+                if self.exporter else "(no exporter)"))
+
+    async def stop(self) -> None:
+        if self._tick_task is not None:
+            self._tick_task.cancel()
+            import contextlib
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._tick_task
+            self._tick_task = None
+        if self.exporter is not None:
+            await self.exporter.stop()
+        await self.monc.close()
+        await self.messenger.shutdown()
+
+    def _on_osdmap(self, payload: dict) -> None:
+        from ceph_tpu.crush.osdmap import apply_map_payload
+        apply_map_payload(self.osdmap, payload)
+        self.monc.sub_got("osdmap", self.osdmap.epoch)
+
+    async def mon_command(self, cmd: dict) -> dict:
+        return await self.monc.command(cmd, timeout=15.0)
+
+    async def _tick_loop(self) -> None:
+        while True:
+            try:
+                self.health = await self.mon_command({"prefix": "health"})
+            except Exception as e:
+                dout("mgr", 4, f"mgr health poll failed: "
+                               f"{type(e).__name__} {e}")
+            for mod in self.modules:
+                try:
+                    await mod.tick(self)
+                except Exception as e:
+                    dout("mgr", 2, f"mgr module {mod.NAME} failed: "
+                                   f"{type(e).__name__} {e}")
+            await asyncio.sleep(self.TICK_INTERVAL)
+
+    def module_status(self) -> dict:
+        return {m.NAME: m.status() for m in self.modules}
+
+    # -- shared cluster-state helpers for modules ----------------------------
+
+    def pg_counts(self) -> dict[int, int]:
+        """PGs hosted per up+in OSD across all pools (acting sets)."""
+        counts = {o: 0 for o, st in self.osdmap.osds.items()
+                  if st.up and st.in_cluster}
+        for pool in self.osdmap.pools.values():
+            for ps in range(pool.pg_num):
+                _, acting = self.osdmap.pg_to_up_acting_osds(
+                    PG(pool.id, ps))
+                for o in acting:
+                    if o in counts:
+                        counts[o] += 1
+        return counts
+
+
+class BalancerModule(MgrModule):
+    """upmap-lite: cap the spread between the most- and least-loaded
+    OSDs by remapping one PG per tick."""
+
+    NAME = "balancer"
+    MAX_SPREAD = 2            # acceptable (max - min) PG count gap
+    MAX_REMAPS = 16           # total overrides this module may own
+
+    def __init__(self):
+        self.remapped: dict = {}       # PG -> override list
+        self.last: dict = {}
+
+    async def tick(self, mgr: MgrDaemon) -> None:
+        await self._gc_stale(mgr)
+        counts = mgr.pg_counts()
+        if len(counts) < 2:
+            return
+        self.last = dict(counts)
+        hot = max(counts, key=lambda o: counts[o])
+        cold = min(counts, key=lambda o: counts[o])
+        if counts[hot] - counts[cold] <= self.MAX_SPREAD:
+            return
+        if len(self.remapped) >= self.MAX_REMAPS:
+            return
+        # find a PG on `hot` that does not already include `cold`
+        for pool in mgr.osdmap.pools.values():
+            for ps in range(pool.pg_num):
+                pgid = PG(pool.id, ps)
+                if pgid in self.remapped or \
+                        pgid in mgr.osdmap.pg_temp:
+                    continue
+                _, acting = mgr.osdmap.pg_to_up_acting_osds(pgid)
+                if hot not in acting or cold in acting:
+                    continue
+                new = [cold if o == hot else o for o in acting]
+                await mgr.mon_command(
+                    {"prefix": "osd pg-temp",
+                     "pgid": [pgid.pool, pgid.ps], "osds": new})
+                self.remapped[pgid] = new
+                dout("mgr", 2, f"balancer: pg {pgid} {acting} -> {new} "
+                               f"(osd.{hot}:{counts[hot]} -> "
+                               f"osd.{cold}:{counts[cold]})")
+                return
+
+    async def _gc_stale(self, mgr: MgrDaemon) -> None:
+        """Erase overrides that now pin a down/out OSD into an acting
+        set: a stale pg-temp would hold a dead OSD there forever.
+        Erasing also un-wedges the MAX_REMAPS budget."""
+        for pgid, osds in list(self.remapped.items()):
+            healthy = all(
+                o in mgr.osdmap.osds and mgr.osdmap.osds[o].up
+                and mgr.osdmap.osds[o].in_cluster for o in osds)
+            if healthy:
+                continue
+            try:
+                await mgr.mon_command(
+                    {"prefix": "osd pg-temp",
+                     "pgid": [pgid.pool, pgid.ps], "osds": []})
+                del self.remapped[pgid]
+                dout("mgr", 2, f"balancer: erased stale remap of {pgid}")
+            except Exception as e:
+                dout("mgr", 4, f"balancer gc failed: "
+                               f"{type(e).__name__} {e}")
+
+    def status(self) -> dict:
+        return {"active_remaps": len(self.remapped),
+                "pg_counts": dict(sorted(self.last.items()))}
+
+
+class PGAutoscalerModule(MgrModule):
+    """Report-only pg_num recommendations toward ~100 PGs per OSD."""
+
+    NAME = "pg_autoscaler"
+    TARGET_PER_OSD = 100
+
+    def __init__(self):
+        self.recommendations: dict[str, dict] = {}
+
+    async def tick(self, mgr: MgrDaemon) -> None:
+        n_osds = sum(1 for st in mgr.osdmap.osds.values()
+                     if st.up and st.in_cluster)
+        if not n_osds or not mgr.osdmap.pools:
+            return
+        budget = n_osds * self.TARGET_PER_OSD
+        total_weight = len(mgr.osdmap.pools)
+        out = {}
+        for pool in mgr.osdmap.pools.values():
+            ideal = max(1, budget // max(1, total_weight * pool.size))
+            # round to the nearest power of two (pg_num convention)
+            target = 1 << max(0, ideal.bit_length() - 1)
+            if target * 2 - ideal < ideal - target:
+                target *= 2
+            out[pool.name] = {"pg_num": pool.pg_num,
+                              "recommended": target,
+                              "would_adjust": target != pool.pg_num}
+        self.recommendations = out
+
+    def status(self) -> dict:
+        return {"pools": self.recommendations}

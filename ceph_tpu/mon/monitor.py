@@ -711,6 +711,17 @@ class Monitor(Dispatcher):
             # unrelated proposal could satisfy early
             out["epoch"] = om.osdmap.epoch
             return out
+        if prefix == "osd pg-temp":
+            # balancer/upmap plane (OSDMonitor prepare_command
+            # "osd pg-temp"): override one PG's acting set; [] erases
+            from ceph_tpu.crush.osdmap import PG as PGId
+            pool_id, ps = cmd["pgid"]
+            osds = [int(o) for o in cmd.get("osds", [])]
+            pending = om.get_pending()
+            pending.new_pg_temp[PGId(int(pool_id), int(ps))] = osds
+            await om.propose_pending()
+            return {"pgid": [pool_id, ps], "osds": osds,
+                    "epoch": om.osdmap.epoch}
         if prefix in ("osd out", "osd in", "osd down"):
             ids = [int(i) for i in cmd.get("ids", [])]
             pending = om.get_pending()

@@ -5,5 +5,7 @@ src/librados/librados_c.cc (public API shape).
 """
 from ceph_tpu.rados.client import (IoCtx, ObjectNotFound, RadosClient,
                                    RadosError)
+from ceph_tpu.rados.aio import AioCompletion, AioDispatcher
 
-__all__ = ["IoCtx", "ObjectNotFound", "RadosClient", "RadosError"]
+__all__ = ["IoCtx", "ObjectNotFound", "RadosClient", "RadosError",
+           "AioCompletion", "AioDispatcher"]

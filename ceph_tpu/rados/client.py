@@ -88,15 +88,8 @@ class RadosClient(Dispatcher):
     # -- map handling --------------------------------------------------------
 
     def _on_osdmap(self, payload: dict) -> None:
-        if payload.get("full") is not None:
-            full = payload["full"]
-            if full["epoch"] > self.osdmap.epoch:
-                self.osdmap.load_dict(full)
-        for raw in payload.get("incrementals", []):
-            inc = Incremental.from_dict(
-                json.loads(raw) if isinstance(raw, str) else raw)
-            if inc.epoch == self.osdmap.epoch + 1:
-                self.osdmap.apply_incremental(inc)
+        from ceph_tpu.crush.osdmap import apply_map_payload
+        apply_map_payload(self.osdmap, payload)
         self.monc.sub_got("osdmap", self.osdmap.epoch)
         self._map_changed.set()
         self._schedule_relinger()
@@ -509,6 +502,40 @@ class IoCtx:
         p, _ = await self._submit(
             oid, [{"op": "omap_rm", "oid": oid, "keys": keys}])
         return p
+
+    # -- aio (librados AioCompletion / neorados role) ------------------------
+
+    @property
+    def _aio(self):
+        from ceph_tpu.rados.aio import AioDispatcher
+        d = getattr(self.client, "_aio_dispatcher", None)
+        if d is None:
+            d = self.client._aio_dispatcher = AioDispatcher()
+        return d
+
+    def aio_write_full(self, oid: str, data: bytes):
+        return self._aio.submit(self.write_full(oid, data))
+
+    def aio_write(self, oid: str, data: bytes, offset: int = 0):
+        return self._aio.submit(self.write(oid, data, offset))
+
+    def aio_append(self, oid: str, data: bytes):
+        return self._aio.submit(self.append(oid, data))
+
+    def aio_read(self, oid: str, offset: int = 0, length: int = 0):
+        return self._aio.submit(self.read(oid, offset, length))
+
+    def aio_remove(self, oid: str):
+        return self._aio.submit(self.remove(oid))
+
+    def aio_stat(self, oid: str):
+        return self._aio.submit(self.stat(oid))
+
+    def aio_operate(self, oid: str, ops: list[dict], data: bytes = b""):
+        return self._aio.submit(self._submit(oid, ops, data))
+
+    async def aio_flush(self) -> None:
+        await self._aio.flush()
 
     # -- watch/notify (rados_watch3 / rados_notify2 subset) ------------------
 

@@ -1,0 +1,84 @@
+"""AIO tests: completions, callbacks, error surfacing, throttle
+backpressure, aio_flush — the LibRadosAio suite's shape
+(src/test/librados/aio.cc: SimpleWrite, WaitForComplete, RoundTrip,
+Flush, IsComplete).
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados.client import ObjectNotFound
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+
+
+def test_aio_roundtrip_callbacks_and_errors(tmp_path):
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("aiop", pg_num=8, size=3)
+            io = cl.ioctx("aiop")
+
+            # burst of writes dispatched without awaiting
+            comps = [io.aio_write_full(f"o{i}", f"data-{i}".encode())
+                     for i in range(32)]
+            fired = []
+            comps[0].add_callback(lambda comp: fired.append(comp))
+            await io.aio_flush()
+            assert all(comp.is_complete() for comp in comps)
+            assert fired and fired[0] is comps[0]
+            # completed: get_return_value answers without awaiting
+            assert comps[0].get_return_value(
+                )["results"][0]["out"]["version"]
+
+            # reads overlap too
+            reads = [io.aio_read(f"o{i}") for i in range(32)]
+            datas = await asyncio.gather(
+                *[r.wait_for_complete() for r in reads])
+            assert datas == [f"data-{i}".encode() for i in range(32)]
+
+            # an error op resolves its completion with the exception
+            bad = io.aio_read("never-existed")
+            with pytest.raises(ObjectNotFound):
+                await bad.wait_for_complete()
+            assert bad.is_complete()
+            # flush never raises even with failed ops outstanding
+            io.aio_read("also-missing")
+            await io.aio_flush()
+
+            # in-flight completion refuses get_return_value
+            slow = io.aio_write_full("late", b"x")
+            if not slow.is_complete():
+                with pytest.raises(ValueError):
+                    slow.get_return_value()
+            await slow.wait_for_complete()
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_aio_throttle_backpressure(tmp_path):
+    """More submissions than the inflight budget: all complete, but the
+    dispatcher never runs more than MAX_INFLIGHT at once."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("thp", pg_num=8, size=3)
+            io = cl.ioctx("thp")
+            from ceph_tpu.rados.aio import AioDispatcher
+            cl._aio_dispatcher = AioDispatcher(max_inflight=4)
+            comps = [io.aio_write_full(f"t{i}", b"z" * 512)
+                     for i in range(40)]
+            await io.aio_flush()
+            assert all(comp.is_complete() for comp in comps)
+            for i in range(40):
+                assert await io.read(f"t{i}") == b"z" * 512
+        finally:
+            await c.stop()
+    run(body())
