@@ -1,12 +1,19 @@
-"""msgr2-subset frame format: TLV preamble + crc32c-protected segments.
+"""msgr2-subset frame format: TLV preamble + crc32c-protected segments,
+plus the negotiated on-wire modes (AES-GCM secure, zlib compression).
 
 Modeled on the reference's frames_v2.h (src/msg/async/frames_v2.h:39-115):
 a frame is a fixed preamble block — tag, segment count, segment lengths,
 preamble crc — followed by the segment payloads, each with its own
-trailing crc32c. Differences from the reference, by design: crc mode only
-(no AES-GCM secure mode, no on-wire compression), at most 4 segments
-(same MAX_NUM_SEGMENTS), no multi-block preambles, and little-endian
-fixed-width ints via struct rather than ceph's dencoder.
+trailing crc32c. After the handshake a connection may negotiate an
+`Onwire` transform over whole encoded frames: AES-128-GCM with
+per-direction keys + counter nonces (the crypto_onwire.cc secure mode;
+keys derived from the cephx-lite shared secret and both handshake
+nonces) and/or zlib compression (compression_onwire.cc). Differences
+from the reference, by design: at most 4 segments (same
+MAX_NUM_SEGMENTS), no multi-block preambles, little-endian fixed-width
+ints via struct rather than ceph's dencoder, and the onwire transform
+wraps the whole frame behind a tiny flags+length header instead of
+rewriting the preamble.
 
 Layout (little-endian):
 
@@ -21,6 +28,7 @@ from __future__ import annotations
 
 import enum
 import struct
+import zlib
 from dataclasses import dataclass, field
 
 from ceph_tpu.native import ec_native
@@ -76,34 +84,147 @@ class Frame:
 
     @classmethod
     async def read(cls, reader) -> "Frame":
-        """Read one frame from an asyncio StreamReader."""
+        """Read one frame from an asyncio StreamReader (gathers the
+        bytes, then parses through the one shared decode path)."""
         fixed = await reader.readexactly(_PRE_FIXED.size)
-        magic, tag, nseg = _PRE_FIXED.unpack(fixed)
+        magic, _tag, nseg = _PRE_FIXED.unpack(fixed)
         if magic != MAGIC:
             raise FrameError(f"bad magic {magic:#x}")
         if nseg > MAX_SEGMENTS:
             raise FrameError(f"{nseg} segments (max {MAX_SEGMENTS})")
         rest = await reader.readexactly(4 * nseg + 4)
         seg_lens = [_U32.unpack_from(rest, 4 * i)[0] for i in range(nseg)]
-        (pre_crc,) = _U32.unpack_from(rest, 4 * nseg)
-        actual = crc32c(fixed + rest[:4 * nseg])
-        if actual != pre_crc:
-            raise FrameError(f"preamble crc {actual:#x} != {pre_crc:#x}")
-        segments = []
         for ln in seg_lens:
             if ln > cls.MAX_SEGMENT_SIZE:
                 raise FrameError(f"segment of {ln} bytes exceeds bound")
-            seg = await reader.readexactly(ln)
-            (seg_crc,) = _U32.unpack(await reader.readexactly(4))
-            actual = crc32c(seg)
-            if actual != seg_crc:
-                raise FrameError(f"segment crc {actual:#x} != {seg_crc:#x}")
-            segments.append(seg)
+        body = await reader.readexactly(sum(ln + 4 for ln in seg_lens))
+        return cls.decode(fixed + rest + body)
+
+    @classmethod
+    def decode(cls, blob: bytes) -> "Frame":
+        """Parse one whole frame from bytes — the single parser behind
+        both read() and the Onwire unwrap path."""
+        try:
+            if len(blob) < _PRE_FIXED.size:
+                raise FrameError("short frame")
+            magic, tag, nseg = _PRE_FIXED.unpack_from(blob, 0)
+            if magic != MAGIC:
+                raise FrameError(f"bad magic {magic:#x}")
+            if nseg > MAX_SEGMENTS:
+                raise FrameError(f"{nseg} segments (max {MAX_SEGMENTS})")
+            off = _PRE_FIXED.size
+            seg_lens = [_U32.unpack_from(blob, off + 4 * i)[0]
+                        for i in range(nseg)]
+            (pre_crc,) = _U32.unpack_from(blob, off + 4 * nseg)
+            if crc32c(blob[:off + 4 * nseg]) != pre_crc:
+                raise FrameError("preamble crc mismatch")
+            off += 4 * nseg + 4
+            segments = []
+            for ln in seg_lens:
+                if ln > cls.MAX_SEGMENT_SIZE:
+                    raise FrameError(f"segment of {ln} bytes exceeds "
+                                     f"bound")
+                seg = blob[off:off + ln]
+                if len(seg) != ln:
+                    raise FrameError("truncated segment")
+                (seg_crc,) = _U32.unpack_from(blob, off + ln)
+                if crc32c(seg) != seg_crc:
+                    raise FrameError("segment crc mismatch")
+                segments.append(seg)
+                off += ln + 4
+        except struct.error as e:
+            raise FrameError(f"truncated frame: {e}") from e
         try:
             tag = Tag(tag)
         except ValueError as e:
             raise FrameError(f"unknown tag {tag}") from e
         return cls(tag, segments)
+
+
+class Onwire:
+    """Post-handshake whole-frame transform: AES-128-GCM secure mode
+    (crypto_onwire.cc) and/or zlib compression (compression_onwire.cc).
+
+    Envelope: u8 flags | u32 payload_len | payload. Per-direction keys
+    derive from the cephx-lite shared secret + both handshake nonces;
+    nonces are a 4-byte per-direction salt plus a monotone 8-byte
+    counter, so every frame of a transport encrypts uniquely and replay
+    or reorder breaks the GCM tag. The flags byte rides as AAD."""
+
+    HDR = struct.Struct("<BI")
+    F_COMPRESSED = 0x1
+    F_SECURE = 0x2
+    COMPRESS_MIN = 512          # don't bloat small control frames
+    MAX_WIRE = 256 << 20
+
+    def __init__(self, compress: bool = False,
+                 secret: bytes | None = None, role: str = "cli",
+                 nonces: tuple[str, str] = ("", "")):
+        self.compress = compress
+        self.secure = secret is not None
+        if self.secure:
+            import hashlib
+            from cryptography.exceptions import InvalidTag
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+            self._InvalidTag = InvalidTag
+            cli_nonce, srv_nonce = nonces
+            base = secret + cli_nonce.encode() + srv_nonce.encode()
+            k_c2s = hashlib.sha256(b"ceph-tpu-c2s" + base).digest()[:16]
+            k_s2c = hashlib.sha256(b"ceph-tpu-s2c" + base).digest()[:16]
+            tx_key, rx_key = (k_c2s, k_s2c) if role == "cli" \
+                else (k_s2c, k_c2s)
+            self._tx = AESGCM(tx_key)
+            self._rx = AESGCM(rx_key)
+            self._tx_salt = hashlib.sha256(b"iv" + tx_key).digest()[:4]
+            self._rx_salt = hashlib.sha256(b"iv" + rx_key).digest()[:4]
+            self._tx_ctr = 0
+            self._rx_ctr = 0
+
+    def wrap(self, blob: bytes) -> bytes:
+        flags = 0
+        if self.compress and len(blob) >= self.COMPRESS_MIN:
+            packed = zlib.compress(blob, 1)
+            if len(packed) < len(blob):
+                blob = packed
+                flags |= self.F_COMPRESSED
+        if self.secure:
+            nonce = self._tx_salt + self._tx_ctr.to_bytes(8, "little")
+            self._tx_ctr += 1
+            blob = self._tx.encrypt(nonce, blob, bytes([flags]))
+            flags |= self.F_SECURE
+        return self.HDR.pack(flags, len(blob)) + blob
+
+    async def read_frame(self, reader) -> Frame:
+        hdr = await reader.readexactly(self.HDR.size)
+        flags, length = self.HDR.unpack(hdr)
+        if length > self.MAX_WIRE:
+            raise FrameError(f"onwire payload of {length} bytes")
+        blob = await reader.readexactly(length)
+        if flags & self.F_SECURE:
+            if not self.secure:
+                raise FrameError("unexpected secure frame")
+            nonce = self._rx_salt + self._rx_ctr.to_bytes(8, "little")
+            self._rx_ctr += 1
+            try:
+                blob = self._rx.decrypt(
+                    nonce, blob, bytes([flags & ~self.F_SECURE]))
+            except self._InvalidTag as e:
+                raise FrameError("GCM auth tag mismatch "
+                                 "(tamper/replay/desync)") from e
+        elif self.secure:
+            raise FrameError("plaintext frame on a secure transport")
+        if flags & self.F_COMPRESSED:
+            # bounded inflate: compression negotiates without auth, so
+            # an unauthenticated peer must not be able to bomb us into
+            # a multi-GB allocation from a small wire payload
+            d = zlib.decompressobj()
+            try:
+                blob = d.decompress(blob, self.MAX_WIRE)
+            except zlib.error as e:
+                raise FrameError(f"decompress failed: {e}") from e
+            if d.unconsumed_tail:
+                raise FrameError("decompressed frame exceeds bound")
+        return Frame.decode(blob)
 
 
 BANNER = b"ceph_tpu msgr2.0\n"

@@ -16,8 +16,11 @@ The reference contract this keeps (src/msg/Messenger.h, ProtocolV2.cc):
 Idiomatic divergences: one asyncio event loop per process instead of
 epoll worker threads; coroutine-per-connection instead of a hand-rolled
 state machine; the banner/HELLO exchange carries JSON instead of
-dencoded structs. Auth is the `none` method only (AuthRegistry slot
-exists conceptually; cephx is out of scope this round).
+dencoded structs. Auth: `none` by default, cephx-lite mutual HMAC when
+an auth_key is set; on top of that the handshake can negotiate AES-GCM
+secure mode and/or zlib on-wire compression (frames.Onwire), with the
+negotiation transcript bound into the auth proofs so a MITM cannot
+silently downgrade either mode.
 """
 from __future__ import annotations
 
@@ -30,16 +33,40 @@ import os
 import time
 from typing import Awaitable, Callable
 
-from ceph_tpu.msg.frames import BANNER, Frame, FrameError, Tag
+from ceph_tpu.msg.frames import BANNER, Frame, FrameError, Tag, Onwire
 from ceph_tpu.msg.messages import Message
 from ceph_tpu.utils.dout import dout
 
 
-def _auth_proof(key: bytes, role: str, nonce_a: str, nonce_b: str) -> str:
+def _build_onwire(agreed: dict, role: str,
+                  auth_key: bytes | None,
+                  cli_nonce: str | None,
+                  srv_nonce: str | None) -> Onwire | None:
+    """Instantiate the negotiated transform (None = plain crc mode)."""
+    secure = bool(agreed.get("secure")) and auth_key is not None \
+        and cli_nonce and srv_nonce
+    compress = bool(agreed.get("compress"))
+    if not secure and not compress:
+        return None
+    return Onwire(compress=compress,
+                  secret=auth_key if secure else None,
+                  role=role, nonces=(cli_nonce or "", srv_nonce or ""))
+
+
+def _auth_proof(key: bytes, role: str, nonce_a: str, nonce_b: str,
+                transcript: str = "") -> str:
     """cephx-lite challenge proof: HMAC-SHA256 over both nonces with a
-    role prefix so the two legs can never be reflected at each other."""
-    return hmac.new(key, f"{role}|{nonce_a}|{nonce_b}".encode(),
+    role prefix so the two legs can never be reflected at each other.
+    `transcript` binds the negotiation (requested + agreed onwire
+    modes): a MITM editing the plaintext handshake to downgrade secure
+    mode breaks both proofs instead of silently succeeding."""
+    return hmac.new(key,
+                    f"{role}|{nonce_a}|{nonce_b}|{transcript}".encode(),
                     hashlib.sha256).hexdigest()
+
+
+def _onwire_transcript(requested: dict, agreed: dict) -> str:
+    return json.dumps([requested or {}, agreed or {}], sort_keys=True)
 
 
 class Policy:
@@ -97,6 +124,7 @@ class Connection:
         self.policy = policy
         self.initiator = initiator
         self.cookie = int.from_bytes(os.urandom(8), "little") if initiator else 0
+        self._onwire: Onwire | None = None   # per-transport, set pre-attach
 
         self.out_seq = 0                    # last seq stamped
         self.in_seq = 0                     # last seq read (dup filter)
@@ -205,6 +233,10 @@ class Connection:
         if self.messenger.auth_key is not None:
             my_nonce = os.urandom(16).hex()
             hello["auth_nonce"] = my_nonce
+        hello["onwire"] = {
+            "compress": self.messenger.compress,
+            "secure": (self.messenger.secure
+                       and self.messenger.auth_key is not None)}
         writer.write(Frame(Tag.RECONNECT if reconnect else Tag.HELLO,
                            [json.dumps(hello).encode()]).encode())
         await writer.drain()
@@ -241,22 +273,32 @@ class Connection:
             return
         if reply.tag in (Tag.HELLO, Tag.RECONNECT_OK):
             info = json.loads(reply.segments[0])
+            agreed = info.get("onwire") or {}
             if self.messenger.auth_key is not None:
                 # cephx-lite leg 2: verify the acceptor's proof, then
-                # send ours — BEFORE any message flows
+                # send ours — BEFORE any message flows. The transcript
+                # covers what we REQUESTED and what was AGREED, so a
+                # stripped/downgraded negotiation fails auth.
+                transcript = _onwire_transcript(hello["onwire"], agreed)
                 proof = _auth_proof(self.messenger.auth_key, "srv",
-                                    my_nonce, info.get("auth_nonce", ""))
+                                    my_nonce, info.get("auth_nonce", ""),
+                                    transcript)
                 if info.get("auth_proof") != proof:
                     raise FrameError("auth failed: acceptor proof "
-                                     "missing or wrong (key mismatch?)")
+                                     "missing or wrong (key mismatch or "
+                                     "negotiation tampering?)")
                 writer.write(Frame(Tag.AUTH, [json.dumps(
                     {"auth_proof": _auth_proof(
                         self.messenger.auth_key, "cli",
-                        info.get("auth_nonce", ""), my_nonce)}
+                        info.get("auth_nonce", ""), my_nonce,
+                        transcript)}
                 ).encode()]).encode())
                 await writer.drain()
             self.peer_name = info.get("entity", "")
             self._requeue_for_replay(info.get("in_seq", 0))
+            self._onwire = _build_onwire(
+                agreed, role="cli", auth_key=self.messenger.auth_key,
+                cli_nonce=my_nonce, srv_nonce=info.get("auth_nonce"))
             self._attach(reader, writer)
             return
         raise FrameError(f"unexpected handshake tag {reply.tag}")
@@ -332,9 +374,10 @@ class Connection:
 
     async def _pump(self) -> None:
         reader, writer = self._reader, self._writer
+        onwire = self._onwire
         self._last_rx = time.monotonic()
-        tasks = [asyncio.create_task(self._read_loop(reader)),
-                 asyncio.create_task(self._write_loop(writer))]
+        tasks = [asyncio.create_task(self._read_loop(reader, onwire)),
+                 asyncio.create_task(self._write_loop(writer, onwire))]
         if not self.policy.lossy:
             tasks.append(asyncio.create_task(self._keepalive_loop()))
         try:
@@ -366,9 +409,11 @@ class Connection:
                     f"keepalive timeout ({stale:.1f}s since last frame)")
             self._out.put_nowait(("keepalive", None))
 
-    async def _read_loop(self, reader) -> None:
+    async def _read_loop(self, reader, onwire: Onwire | None = None
+                         ) -> None:
         while True:
-            frame = await Frame.read(reader)
+            frame = await (onwire.read_frame(reader) if onwire
+                           else Frame.read(reader))
             self._last_rx = time.monotonic()
             if frame.tag == Tag.MESSAGE:
                 msg = Message.decode_segments(frame.segments)
@@ -407,7 +452,8 @@ class Connection:
 
     IDLE_ACK_S = 0.5   # flush pending acks when the queue goes quiet
 
-    async def _write_loop(self, writer) -> None:
+    async def _write_loop(self, writer,
+                          onwire: Onwire | None = None) -> None:
         while True:
             try:
                 item = await asyncio.wait_for(self._out.get(),
@@ -432,7 +478,10 @@ class Connection:
                 frame = Frame(Tag.KEEPALIVE_ACK, [])
             else:  # pragma: no cover
                 continue
-            writer.write(frame.encode())
+            blob = frame.encode()
+            if onwire is not None:
+                blob = onwire.wrap(blob)
+            writer.write(blob)
             await writer.drain()
 
     def _trim_sent(self, acked_seq: int) -> None:
@@ -453,13 +502,28 @@ class Messenger:
                       conn = await m.connect(addr, Policy.lossy_client())
     """
 
-    def __init__(self, entity_name: str, auth_key: bytes | None = None):
+    #: process-wide mode defaults (ms_compress_* / ms_secure conf):
+    #: daemons build their Messengers internally, so a deployment turns
+    #: modes on here (or per-instance via the ctor args)
+    DEFAULT_COMPRESS = False
+    DEFAULT_SECURE = False
+
+    def __init__(self, entity_name: str, auth_key: bytes | None = None,
+                 compress: bool | None = None,
+                 secure: bool | None = None):
         self.entity_name = entity_name
+        # negotiated on-wire modes (ProtocolV2 secure mode + on-wire
+        # compression): both sides must want a mode for it to engage;
+        # secure additionally requires the cephx-lite shared key
+        self.compress = self.DEFAULT_COMPRESS if compress is None \
+            else compress
+        self.secure = self.DEFAULT_SECURE if secure is None else secure
         # cephx-lite: a shared cluster secret. When set, every session
         # (in AND out) must pass mutual HMAC challenge-response before
         # any message is exchanged (the reference's cephx mutual auth
-        # collapsed onto one service key; divergence: no per-message
-        # signing or on-wire encryption — crc mode only)
+        # collapsed onto one service key). With secure=True the same
+        # key also seeds the AES-GCM onwire mode; without it, crc mode
+        # (optionally compressed)
         self.auth_key = auth_key
         self.dispatchers: list[Dispatcher] = []
         self._server: asyncio.base_events.Server | None = None
@@ -482,6 +546,15 @@ class Messenger:
         dout("ms", 10, f"{self.entity_name} listening on {self.my_addr}")
         return self.my_addr
 
+    def _negotiate_onwire(self, info: dict) -> dict:
+        """Intersection of the initiator's requested modes and ours
+        (ProtocolV2 feature negotiation)."""
+        want = info.get("onwire") or {}
+        return {"compress": bool(want.get("compress")) and self.compress,
+                "secure": (bool(want.get("secure")) and self.secure
+                           and self.auth_key is not None
+                           and bool(info.get("auth_nonce")))}
+
     async def _on_accept(self, reader, writer) -> None:
         try:
             writer.write(BANNER)
@@ -499,10 +572,12 @@ class Messenger:
         key = (info.get("entity", "?"), info.get("cookie", 0))
         peer_in_seq = info.get("in_seq", 0)
 
-        def _auth_fields(reply: dict) -> tuple[bool, str | None]:
+        def _auth_fields(reply: dict,
+                         agreed: dict) -> tuple[bool, str | None]:
             """cephx-lite acceptor: add our nonce+proof to the outgoing
             reply; returns (ok, expected initiator proof). The expected
-            proof NEVER enters the wire-bound dict."""
+            proof NEVER enters the wire-bound dict. Proofs bind the
+            onwire negotiation transcript (anti-downgrade)."""
             if self.auth_key is None:
                 return True, None
             peer_nonce = info.get("auth_nonce")
@@ -511,12 +586,14 @@ class Messenger:
                               f"unauthenticated peer {key[0]}")
                 writer.close()
                 return False, None
+            transcript = _onwire_transcript(info.get("onwire"), agreed)
             my_nonce = os.urandom(16).hex()
             reply["auth_nonce"] = my_nonce
             reply["auth_proof"] = _auth_proof(self.auth_key, "srv",
-                                              peer_nonce, my_nonce)
+                                              peer_nonce, my_nonce,
+                                              transcript)
             return True, _auth_proof(self.auth_key, "cli", my_nonce,
-                                     peer_nonce)
+                                     peer_nonce, transcript)
 
         async def _auth_verify(want: str | None) -> bool:
             if want is None:
@@ -550,7 +627,9 @@ class Messenger:
             # kill an authenticated session's transport
             reply = {"entity": self.entity_name,
                      "in_seq": conn._processed_seq}
-            ok, expect = _auth_fields(reply)
+            agreed = self._negotiate_onwire(info)
+            reply["onwire"] = agreed
+            ok, expect = _auth_fields(reply, agreed)
             if not ok:
                 return
             writer.write(Frame(Tag.RECONNECT_OK,
@@ -560,6 +639,10 @@ class Messenger:
                 return
             await conn._close_transport()
             conn._requeue_for_replay(peer_in_seq)
+            conn._onwire = _build_onwire(
+                agreed, role="srv", auth_key=self.auth_key,
+                cli_nonce=info.get("auth_nonce"),
+                srv_nonce=reply.get("auth_nonce"))
             conn._attach(reader, writer)
             return
 
@@ -568,13 +651,19 @@ class Messenger:
         conn.peer_name = info["entity"]
         conn.cookie = info.get("cookie", 0)
         reply = {"entity": self.entity_name, "in_seq": 0}
-        ok, expect = _auth_fields(reply)
+        agreed = self._negotiate_onwire(info)
+        reply["onwire"] = agreed
+        ok, expect = _auth_fields(reply, agreed)
         if not ok:
             return
         writer.write(Frame(Tag.HELLO, [json.dumps(reply).encode()]).encode())
         await writer.drain()
         if not await _auth_verify(expect):
             return
+        conn._onwire = _build_onwire(
+            agreed, role="srv", auth_key=self.auth_key,
+            cli_nonce=info.get("auth_nonce"),
+            srv_nonce=reply.get("auth_nonce"))
         conn._attach(reader, writer)
         if not policy.lossy:
             # one lossless session per peer entity: a fresh HELLO from an

@@ -1,0 +1,86 @@
+"""cephadm-lite tests: spec apply/converge, scale-out, daemon restart
+(rolling-upgrade primitive), inventory — the orchestrator surface
+(qa cephadm smoke + mgr/cephadm orch apply coverage).
+"""
+from __future__ import annotations
+
+import asyncio
+
+from ceph_tpu.tools.cephadm import CephadmCluster
+
+from tests.test_cluster import fast_timers, run  # noqa: F401
+
+
+def test_apply_scale_and_restart(tmp_path):
+    async def body():
+        cluster = CephadmCluster(str(tmp_path / "cl"))
+        try:
+            report = await cluster.apply(
+                {"mon": {"count": 1},
+                 "osd": {"count": 3, "backend": "bluestore"},
+                 "mgr": {"count": 1},
+                 "pools": [{"name": "rbdpool", "pg_num": 8,
+                            "size": 3}]})
+            inv = report["inventory"]
+            assert sorted(k for k in inv if k.startswith("osd")) == \
+                ["osd.0", "osd.1", "osd.2"]
+            assert "mon.m0" in inv and "mgr.0" in inv
+            assert any("pool.create" in a for a in report["applied"])
+
+            admin = await cluster._admin_client()
+            io = admin.ioctx("rbdpool")
+            await io.write_full("obj", b"v1" * 2000)
+
+            # scale out: re-apply with one more osd; existing untouched
+            report = await cluster.apply(
+                {"mon": {"count": 1},
+                 "osd": {"count": 4, "backend": "bluestore"},
+                 "mgr": {"count": 1},
+                 "pools": [{"name": "rbdpool", "pg_num": 8,
+                            "size": 3}]})
+            assert report["applied"] == ["osd.3 deployed (bluestore)"]
+            assert "osd.3" in report["inventory"]
+
+            # rolling restart: osd.0 comes back from its bluestore dir
+            await cluster.daemon_restart("osd", 0)
+            await asyncio.sleep(1.5)        # re-peer
+            assert await io.read("obj") == b"v1" * 2000
+            assert cluster.inventory()["osd.0"]["store"] == "BlueStore"
+
+            # scale in removes the surplus daemon
+            report = await cluster.apply(
+                {"mon": {"count": 1},
+                 "osd": {"count": 3, "backend": "bluestore"},
+                 "mgr": {"count": 1},
+                 "pools": [{"name": "rbdpool", "pg_num": 8,
+                            "size": 3}]})
+            assert "osd.3 removed" in report["applied"]
+            await asyncio.sleep(1.0)
+            assert await io.read("obj") == b"v1" * 2000
+        finally:
+            await cluster.stop()
+    run(body())
+
+
+def test_apply_with_mds_bootstraps_fs_pools(tmp_path):
+    async def body():
+        cluster = CephadmCluster(str(tmp_path / "cl2"))
+        try:
+            await cluster.apply({"mon": {"count": 1},
+                                 "osd": {"count": 3,
+                                         "backend": "memstore"},
+                                 "mds": {"count": 1}})
+            admin = await cluster._admin_client()
+            assert "cephfs_metadata" in admin.osdmap.pool_names
+            assert "cephfs_data" in admin.osdmap.pool_names
+            from ceph_tpu.mds import CephFS
+            mds = cluster.mdss[0]
+            fs = CephFS(cluster.mon_addrs, mds.addr)
+            await fs.mount()
+            await fs.mkdir("/adm")
+            await fs.write_file("/adm/x", b"via orchestrated mds")
+            assert await fs.read_file("/adm/x") == b"via orchestrated mds"
+            await fs.unmount()
+        finally:
+            await cluster.stop()
+    run(body())
