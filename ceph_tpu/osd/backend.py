@@ -107,7 +107,17 @@ class PGBackend:
         cid = self.coll(shard)
         gh = self.ghobject(oid, shard)
         txn = Transaction()
-        if op in ("write_full", "push"):
+        if op == "write_full":
+            # WRITEFULL replaces the DATA only — xattrs and omap survive
+            # (the reference's CEPH_OSD_OP_WRITEFULL; an RBD header
+            # rewrite must not wipe its cls-lock omap state)
+            if self.host.store.exists(cid, gh):
+                txn.truncate(cid, gh, 0)
+            else:
+                txn.touch(cid, gh)
+            txn.write(cid, gh, 0, data)
+        elif op == "push":
+            # recovery push IS full-state: replace everything
             if self.host.store.exists(cid, gh):
                 txn.remove(cid, gh)
             txn.touch(cid, gh)
@@ -115,7 +125,6 @@ class PGBackend:
             if attrs:
                 txn.setattrs(cid, gh, attrs)
             if omap:
-                # full-state pushes replace omap atomically with the data
                 txn.omap_setkeys(cid, gh, omap)
         elif op == "write":
             if not self.host.store.exists(cid, gh):
@@ -329,9 +338,11 @@ class ReplicatedBackend(PGBackend):
         self.local_apply(p["oid"], p["op"], msg.data, off=p.get("off", 0))
         if entry.version > self.pg.log.head:
             self.pg.log.append(entry)
-        if p["op"] in ("write_full", "push", "delete", "create"):
+        if p["op"] in ("push", "delete", "create"):
             # only FULL-state ops supersede a missing base; an extent
-            # write to a recovering replica leaves it missing
+            # write — and now write_full too, since it preserves
+            # xattrs/omap it cannot supply — leaves a missing object
+            # missing until recovery pushes the whole state
             self.pg.log.mark_recovered(p["oid"])
         self.pg.persist_meta()
         conn.send_message(MOSDRepOpReply(

@@ -152,11 +152,13 @@ class CephadmCluster:
 
     async def _apply_mdss(self, count: int, actions: list[str]) -> None:
         from ceph_tpu.mds import MDSDaemon
-        if count and "cephfs_metadata" not in \
-                (await self._admin_client()).osdmap.pool_names:
+        if count:
+            # each pool converges independently: a crash between the
+            # two creates must heal on re-apply
             admin = await self._admin_client()
-            await admin.pool_create("cephfs_metadata", pg_num=8)
-            await admin.pool_create("cephfs_data", pg_num=8)
+            for pool in ("cephfs_metadata", "cephfs_data"):
+                if pool not in admin.osdmap.pool_names:
+                    await admin.pool_create(pool, pg_num=8)
         for i in range(count):
             if i in self.mdss:
                 continue

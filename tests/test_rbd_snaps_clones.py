@@ -1,0 +1,164 @@
+"""RBD snapshots, rollback, clone layering, copy-up, flatten,
+exclusive lock, and header-watch invalidation — the librbd feature
+tests' shape (src/test/librbd/test_librbd.cc: TestSnapshot*, TestClone,
+TestCopyup, LockingPP, resize propagation).
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados.client import RadosError
+from ceph_tpu.rbd.image import RBD, Image
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+
+MB = 1 << 20
+
+
+async def _cluster(tmp_path, pool="rbd"):
+    c = ClusterHarness(tmp_path)
+    await c.start()
+    cl = await c.client()
+    await cl.pool_create(pool, pg_num=8, size=3)
+    return c, cl.ioctx(pool)
+
+
+def test_image_snapshots_and_rollback(tmp_path):
+    async def body():
+        c, io = await _cluster(tmp_path)
+        try:
+            await RBD.create(io, "img", 8 * MB, order=20)  # 1 MiB objs
+            img = await Image.open(io, "img")
+            await img.write(0, b"gen1" * 1000)
+            await img.write(3 * MB, b"tail" * 100)
+
+            await img.snap_create("s1")
+            await img.write(0, b"gen2" * 1000)
+            assert await img.read(0, 4000) == b"gen2" * 1000
+
+            # read-only view at the snapshot
+            at_s1 = await Image.open(io, "img", snap_name="s1")
+            assert await at_s1.read(0, 4000) == b"gen1" * 1000
+            assert await at_s1.read(3 * MB, 400) == b"tail" * 100
+            with pytest.raises(RadosError) as ei:
+                await at_s1.write(0, b"nope")
+            assert ei.value.rc == -30
+            await at_s1.close()
+
+            # an object created AFTER the snap vanishes on rollback
+            await img.write(5 * MB, b"late-object")
+            await img.snap_rollback("s1")
+            assert await img.read(0, 4000) == b"gen1" * 1000
+            assert await img.read(5 * MB, 11) == b"\0" * 11
+
+            # snap removal trims; the view is gone
+            await img.snap_remove("s1")
+            assert img.snap_list() == {}
+            await img.close()
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_clone_copyup_flatten(tmp_path):
+    async def body():
+        c, io = await _cluster(tmp_path)
+        try:
+            await RBD.create(io, "parent", 4 * MB, order=20)
+            parent = await Image.open(io, "parent")
+            await parent.write(0, b"P" * (MB + 512))       # objs 0+1
+            await parent.snap_create("base")
+            await parent.write(0, b"X" * 100)              # post-snap
+
+            await RBD.clone(io, "parent", "base", "child")
+            child = await Image.open(io, "child")
+            # reads fall through to parent@base, not parent head
+            assert await child.read(0, 100) == b"P" * 100
+            assert await child.read(MB, 512) == b"P" * 512
+            assert await child.read(2 * MB, 10) == b"\0" * 10
+
+            # partial write triggers copy-up: the rest of the object
+            # keeps the parent's bytes
+            await child.write(10, b"c" * 20)
+            got = await child.read(0, 100)
+            assert got == b"P" * 10 + b"c" * 20 + b"P" * 70
+            # the parent head is untouched by the child's copy-up
+            assert await parent.read(0, 100) == b"X" * 100
+            at_base = await Image.open(io, "parent", snap_name="base")
+            assert await at_base.read(10, 20) == b"P" * 20
+            await at_base.close()
+
+            # discard under the overlap zeroes instead of exposing the
+            # parent again
+            await child.discard(MB, 512)
+            assert await child.read(MB, 512) == b"\0" * 512
+
+            # flatten: child self-contained; parent link gone
+            await child.flatten()
+            assert (await child.stat())["parent"] is None
+            assert await child.read(0, 100) == \
+                b"P" * 10 + b"c" * 20 + b"P" * 70
+            # parent snap can now be removed without breaking the child
+            await parent.snap_remove("base")
+            assert await child.read(0, 40) == b"P" * 10 + b"c" * 20 \
+                + b"P" * 10
+            await child.close()
+            await parent.close()
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_exclusive_lock(tmp_path):
+    async def body():
+        c, io = await _cluster(tmp_path)
+        try:
+            await RBD.create(io, "locked", MB, order=20)
+            a = await Image.open(io, "locked")
+            b = await Image.open(io, "locked")
+            await a.lock_acquire()
+            info = await b.lock_info()
+            assert info["locker"]["locker"].startswith("client.")
+            with pytest.raises(RadosError) as ei:
+                await b.lock_acquire()
+            assert ei.value.rc == -16                      # EBUSY
+            await a.lock_release()
+            await b.lock_acquire()
+            # a dead holder's lock can be broken
+            await a.break_lock()
+            await a.lock_acquire()
+            await a.close()
+            await b.close()
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_header_watch_invalidation(tmp_path):
+    async def body():
+        c, io = await _cluster(tmp_path)
+        try:
+            await RBD.create(io, "shared", 2 * MB, order=20)
+            watcher = await Image.open(io, "shared", watch=True)
+            other = await Image.open(io, "shared")
+            await other.resize(6 * MB)
+            deadline = asyncio.get_running_loop().time() + 10
+            while watcher.size != 6 * MB:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError(
+                        f"watcher never refreshed ({watcher.size})")
+                await asyncio.sleep(0.1)
+            # snap from one handle appears on the other
+            await other.snap_create("v1")
+            deadline = asyncio.get_running_loop().time() + 10
+            while "v1" not in watcher.snap_list():
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError("snap never propagated")
+                await asyncio.sleep(0.1)
+            await watcher.close()
+            await other.close()
+        finally:
+            await c.stop()
+    run(body())
