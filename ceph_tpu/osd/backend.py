@@ -301,8 +301,20 @@ class ReplicatedBackend(PGBackend):
                  if o not in (CRUSH_NONE, self.host.whoami)}
         tid = self.new_tid()
         fut = self._start_waiting(tid, peers)
-        # local first (the primary is always a replica of itself)
+        # local first (the primary is always a replica of itself) — and
+        # the LOG ENTRY lands atomically with the local apply, BEFORE
+        # any ack wait. If the op then fails mid-fan-out (interval
+        # change, primary loss), the applied data is never unlogged:
+        # the client's retry hits the dup index instead of re-executing
+        # against polluted local state (an unlogged applied APPEND made
+        # a retry resolve its offset one payload too far — found by the
+        # thrashing model checker). The reference writes pg log entries
+        # in the same ObjectStore transaction as the data for exactly
+        # this reason.
         self.local_apply(oid, op, data, off=off)
+        if entry.version > pg.log.head:
+            pg.log.append(entry)
+            pg.persist_meta()
         msg_payload = {
             "pgid": [pg.pgid.pool, pg.pgid.ps],
             "tid": tid,

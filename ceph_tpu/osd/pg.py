@@ -1131,8 +1131,12 @@ class PGInstance:
                          else None)
         await self.backend.execute_write(oid, kind, data, entry,
                                          off=op.get("off", 0))
-        self.log.append(entry)
-        self.persist_meta()
+        # the replicated backend logs the entry atomically with its
+        # local apply (pre-ack, see backend.execute_write); appending
+        # here covers backends that do not
+        if entry.version > self.log.head:
+            self.log.append(entry)
+            self.persist_meta()
         return 0, {"version": list(version)}, b""
 
     async def _make_writeable(self, oid: str, snapc: dict,
@@ -1151,8 +1155,9 @@ class PGInstance:
                          prior_version=self._prior(oid),
                          reqid=(*reqid, 90) if reqid else None)
         await self.backend.execute_write(oid, "clone", payload, entry)
-        self.log.append(entry)
-        self.persist_meta()
+        if entry.version > self.log.head:
+            self.log.append(entry)
+            self.persist_meta()
 
     def _prior(self, oid: str) -> Eversion:
         for e in reversed(self.log.entries):

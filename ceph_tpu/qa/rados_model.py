@@ -26,8 +26,11 @@ from ceph_tpu.utils.dout import dout
 class ModelRunner:
     """Random-op workload + in-memory truth for ONE pool."""
 
+    MAX_SNAPS = 3
+
     def __init__(self, io, rng: random.Random, ec_pool: bool,
-                 stripe: int = 8192, max_objects: int = 24):
+                 stripe: int = 8192, max_objects: int = 24,
+                 enable_snaps: bool = False):
         self.io = io
         self.rng = rng
         self.ec = ec_pool
@@ -38,6 +41,13 @@ class ModelRunner:
         self.uncertain: dict[str, tuple] = {}
         self.ops_run = 0
         self.uncertain_ops = 0
+        # snapshots (replicated pools): name -> {"id", "state": whole
+        # model at snap time}; taken only while the model is exact, so
+        # snap reads verify EXACTLY — clones must survive thrashing
+        self.enable_snaps = enable_snaps and not ec_pool
+        self.snaps: dict[str, dict] = {}
+        self._snap_seq_names = 0
+        self.snap_ops = 0
 
     def _oid(self) -> str:
         return f"m{self.rng.randrange(self.max_objects):03d}"
@@ -82,6 +92,9 @@ class ModelRunner:
             # full-state write instead — RadosModel resolves in-flight
             # ambiguity the same way
             roll = 0.0
+        if self.enable_snaps and roll >= 0.97:
+            await self._snap_op()
+            return
         if roll < 0.25:
             data = self._payload()
             await self._mutate(oid, self.io.write_full(oid, data), data)
@@ -107,6 +120,76 @@ class ModelRunner:
             await self._check_read(oid)
         else:
             await self._check_stat(oid)
+
+    # -- snapshots --------------------------------------------------------
+
+    def _apply_snapc(self) -> None:
+        ids = sorted((s["id"] for s in self.snaps.values()),
+                     reverse=True)
+        self.io.set_snap_context(ids[0] if ids else 0, ids)
+
+    async def _snap_op(self) -> None:
+        self.snap_ops += 1
+        roll = self.rng.random()
+        if self.snaps and (roll < 0.3 or len(self.snaps) >= self.MAX_SNAPS):
+            name = self.rng.choice(sorted(self.snaps))
+            snap = self.snaps[name]
+            try:
+                await self.io.selfmanaged_snap_rm(snap["id"])
+            except (RadosError, TimeoutError, asyncio.TimeoutError):
+                pass        # removal may or may not have landed: either
+                #             way we stop checking this snap
+            self.snaps.pop(name, None)
+            self._apply_snapc()
+            return
+        if roll < 0.6 and self.snaps:
+            await self._check_snap_read()
+            return
+        if self.uncertain:
+            return          # only snapshot an exact model
+        try:
+            snapid = await self.io.selfmanaged_snap_create()
+        except (RadosError, TimeoutError, asyncio.TimeoutError) as e:
+            # an orphaned snap id (command committed, reply lost) forms
+            # no clones because our snapc never includes it
+            dout("qa", 3, f"model: snap create unknown ({e})")
+            return
+        self._snap_seq_names += 1
+        name = f"s{self._snap_seq_names}"
+        self.snaps[name] = {"id": snapid,
+                            "state": {o: bytes(v)
+                                      for o, v in self.model.items()}}
+        self._apply_snapc()
+        dout("qa", 3, f"model: snap {name} = {snapid} "
+                      f"({len(self.model)} objects)")
+
+    async def _check_snap_read(self) -> None:
+        name = self.rng.choice(sorted(self.snaps))
+        snap = self.snaps[name]
+        oid = self._oid()
+        want = snap["state"].get(oid)
+        try:
+            data = await self.io.read(oid, snapid=snap["id"])
+        except ObjectNotFound:
+            assert want is None,                 f"{oid}@{name}: ENOENT, snap state has {len(want)}B"
+            return
+        except (RadosError, TimeoutError, asyncio.TimeoutError):
+            return          # transiently unreadable mid-thrash
+        assert want is not None and data == want,             f"{oid}@{name}: {len(data)}B != snap state "             f"{len(want) if want is not None else None}"
+
+    async def _final_snap_check(self) -> None:
+        for name, snap in sorted(self.snaps.items()):
+            for oid, want in sorted(snap["state"].items()):
+                try:
+                    data = await self.io.read(oid, snapid=snap["id"])
+                except ObjectNotFound:
+                    raise AssertionError(
+                        f"{oid}@{name}: snapshot data lost")
+                except (RadosError, TimeoutError,
+                        asyncio.TimeoutError) as e:
+                    raise AssertionError(f"{oid}@{name}: unreadable "
+                                         f"({e})")
+                assert data == want,                     f"{oid}@{name}: snapshot content mismatch"
 
     # -- verification ----------------------------------------------------
 
@@ -179,6 +262,7 @@ class ModelRunner:
         stray = listed - may_exist
         assert not missing, f"objects lost: {sorted(missing)}"
         assert not stray, f"objects resurrected: {sorted(stray)}"
+        await self._final_snap_check()
 
 
 class Thrasher:

@@ -146,9 +146,14 @@ class Image:
                 raise RadosError(-2, f"no snap {snap_name!r}")
             self.snap_id = snap["id"]
             self.size = int(snap["size"])
+            # older snap records predate parent pinning: fall back to
+            # the live header's link
+            self._view_parent = snap.get("parent",
+                                         header.get("parent"))
         else:
             self.snap_id = None
             self.size = int(header["size"])
+            self._view_parent = None
         self._apply_snapc()
         # serialize header rewrites (resize/snap ops) per open handle
         self._hdr_lock = asyncio.Lock()
@@ -253,8 +258,15 @@ class Image:
 
     # -- parent (layering) ---------------------------------------------------
 
+    def _parent_ref(self) -> dict | None:
+        """The parent link THIS handle reads through: the pinned
+        per-snapshot link for snap views, the live header's otherwise."""
+        if self.snap_id is not None:
+            return self._view_parent
+        return self.header.get("parent")
+
     async def _get_parent(self) -> "Image | None":
-        p = self.header.get("parent")
+        p = self._parent_ref()
         if p is None:
             return None
         if self._parent is None:
@@ -265,7 +277,7 @@ class Image:
     async def _read_parent(self, idx: int, ooff: int, n: int) -> bytes:
         """Bytes from the parent snapshot for the child's absent object
         (clipped to the overlap); zeros beyond."""
-        p = self.header.get("parent")
+        p = self._parent_ref()
         if p is None:
             return b"\0" * n
         off = idx * self.object_size + ooff
@@ -314,6 +326,8 @@ class Image:
                                                  offset=ooff, length=n)
                 parts.append(data + b"\0" * (n - len(data)))
             except ObjectNotFound:
+                # falls through to the snap-pinned parent for views,
+                # the live parent for head reads
                 parts.append(await self._read_parent(idx, ooff, n))
         return b"".join(parts)
 
@@ -428,8 +442,13 @@ class Image:
             if snap_name in self.header["snaps"]:
                 raise RadosError(-17, f"snap {snap_name!r} exists")
             snapid = await self.ioctx.selfmanaged_snap_create()
-            self.header["snaps"][snap_name] = {"id": snapid,
-                                               "size": self.size}
+            # pin the parent linkage AS OF the snapshot: flatten (or a
+            # shrinking resize clamping the overlap) must not turn this
+            # snap's parent-backed reads into zeros later
+            parent = self.header.get("parent")
+            self.header["snaps"][snap_name] = {
+                "id": snapid, "size": self.size,
+                "parent": dict(parent) if parent else None}
             self.header["snap_seq"] = snapid
             await self._write_header()
             self._apply_snapc()

@@ -20,15 +20,23 @@ Layout in RADOS: one data pool; bucket index object
 object data in `<bucket>/<key>`. Multi-op semantics match S3's
 read-after-write for new objects.
 
+Multipart uploads (src/rgw/rgw_op.cc RGWInitMultipart/
+RGWPutObj part path/RGWCompleteMultipart): POST /b/k?uploads initiates
+and returns an UploadId; PUT /b/k?partNumber=N&uploadId=U stores parts
+as `.mp.<id>.<n>` objects; POST /b/k?uploadId=U concatenates the parts
+in part-number order into the final object and deletes them; DELETE
+with uploadId aborts and reclaims parts.
+
 Idiomatic divergences: no auth sigv4 (cephx-lite guards the RADOS
 plane; HTTP is trusted-localhost like a behind-proxy deployment), XML
-only where S3 clients require it, single-part uploads only.
+only where S3 clients require it.
 """
 from __future__ import annotations
 
 import asyncio
 import json
-from urllib.parse import unquote
+import secrets
+from urllib.parse import parse_qs, unquote, urlsplit
 from xml.sax.saxutils import escape
 
 from ceph_tpu.rados.client import IoCtx, ObjectNotFound
@@ -75,7 +83,10 @@ class RGWGateway:
             parts = request.decode(errors="replace").split()
             if len(parts) < 2:
                 return
-            method, path = parts[0].upper(), unquote(parts[1].split("?")[0])
+            url = urlsplit(parts[1])
+            method, path = parts[0].upper(), unquote(url.path)
+            query = {k: v[0] for k, v in parse_qs(
+                url.query, keep_blank_values=True).items()}
             length = 0
             while True:
                 line = await asyncio.wait_for(reader.readline(), 30.0)
@@ -85,7 +96,8 @@ class RGWGateway:
                 if name.strip().lower() == "content-length":
                     length = int(value.strip())
             body = await reader.readexactly(length) if length else b""
-            code, headers, out = await self._process(method, path, body)
+            code, headers, out = await self._process(method, path, body,
+                                                     query)
         except (asyncio.TimeoutError, asyncio.IncompleteReadError,
                 OSError):
             writer.close()
@@ -108,8 +120,10 @@ class RGWGateway:
 
     # -- S3 semantics --------------------------------------------------------
 
-    async def _process(self, method: str, path: str,
-                       body: bytes) -> tuple[int, dict, bytes]:
+    async def _process(self, method: str, path: str, body: bytes,
+                       query: dict | None = None
+                       ) -> tuple[int, dict, bytes]:
+        query = query or {}
         parts = [p for p in path.split("/") if p]
         if not parts:
             if method == "GET":
@@ -124,6 +138,16 @@ class RGWGateway:
             if method == "DELETE":
                 return await self._delete_bucket(bucket)
             return 405, {}, b"MethodNotAllowed"
+        if method == "POST" and "uploads" in query:
+            return await self._initiate_multipart(bucket, key)
+        if method == "POST" and "uploadId" in query:
+            return await self._complete_multipart(bucket, key,
+                                                  query["uploadId"])
+        if method == "PUT" and "uploadId" in query:
+            return await self._put_part(bucket, key, query, body)
+        if method == "DELETE" and "uploadId" in query:
+            return await self._abort_multipart(bucket, key,
+                                               query["uploadId"])
         if method == "PUT":
             return await self._put_object(bucket, key, body)
         if method == "GET":
@@ -224,6 +248,120 @@ class RGWGateway:
         return 204, {}, b""
 
 
-_REASON = {200: "OK", 204: "No Content", 404: "Not Found",
-           405: "Method Not Allowed", 409: "Conflict",
+    # -- multipart (RGWInitMultipart / part put / RGWCompleteMultipart) ------
+
+    @staticmethod
+    def _part_oid(upload_id: str, n: int) -> str:
+        return f".mp.{upload_id}.{n:05d}"
+
+    @staticmethod
+    def _upload_meta_oid(upload_id: str) -> str:
+        return f".mp.{upload_id}.meta"
+
+    async def _initiate_multipart(self, bucket: str,
+                                  key: str) -> tuple[int, dict, bytes]:
+        if not await self._bucket_exists(bucket):
+            return 404, {}, b"NoSuchBucket"
+        upload_id = secrets.token_hex(12)
+        await self.io.write_full(
+            self._upload_meta_oid(upload_id),
+            json.dumps({"bucket": bucket, "key": key}).encode())
+        xml = (f"<InitiateMultipartUploadResult>"
+               f"<Bucket>{escape(bucket)}</Bucket>"
+               f"<Key>{escape(key)}</Key>"
+               f"<UploadId>{upload_id}</UploadId>"
+               f"</InitiateMultipartUploadResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    async def _load_upload(self, upload_id: str) -> dict | None:
+        try:
+            return json.loads(
+                await self.io.read(self._upload_meta_oid(upload_id)))
+        except (ObjectNotFound, ValueError):
+            return None
+
+    async def _put_part(self, bucket: str, key: str, query: dict,
+                        body: bytes) -> tuple[int, dict, bytes]:
+        upload_id = query["uploadId"]
+        meta = await self._load_upload(upload_id)
+        if meta is None or (meta["bucket"], meta["key"]) != (bucket, key):
+            return 404, {}, b"NoSuchUpload"
+        try:
+            n = int(query.get("partNumber", "0"))
+        except ValueError:
+            n = 0
+        if not 1 <= n <= 10000:
+            return 400, {}, b"InvalidPartNumber"
+        from ceph_tpu.native import ec_native
+        etag = f"{ec_native.crc32c(body):08x}"
+        await self.io.write_full(self._part_oid(upload_id, n), body)
+        return 200, {"ETag": f'"{etag}"'}, b""
+
+    async def _upload_parts(self, upload_id: str) -> list[str]:
+        prefix = f".mp.{upload_id}."
+        return sorted(o for o in await self.io.list_objects()
+                      if o.startswith(prefix)
+                      and not o.endswith(".meta"))
+
+    async def _complete_multipart(self, bucket: str, key: str,
+                                  upload_id: str
+                                  ) -> tuple[int, dict, bytes]:
+        meta = await self._load_upload(upload_id)
+        if meta is None or (meta["bucket"], meta["key"]) != (bucket, key):
+            return 404, {}, b"NoSuchUpload"
+        if not await self._bucket_exists(bucket):
+            # the bucket died while the upload was in flight: completing
+            # must not resurrect it through the index omap_set
+            return 404, {}, b"NoSuchBucket"
+        parts = await self._upload_parts(upload_id)
+        if not parts:
+            return 400, {}, b"InvalidRequest: no parts"
+        # concatenate in part order via ranged appends: the final
+        # object replaces any previous content. The rolling crc starts
+        # at crc32c's default seed so the multipart ETag prefix matches
+        # what GET recomputes over the same bytes
+        from ceph_tpu.native import ec_native
+        total = 0
+        crc = 0xFFFFFFFF
+        dst = _data_oid(bucket, key)
+        for i, oid in enumerate(parts):
+            blob = await self.io.read(oid)
+            if i == 0:
+                await self.io.write_full(dst, blob)
+            else:
+                await self.io.write(dst, blob, offset=total)
+            crc = ec_native.crc32c(blob, crc)
+            total += len(blob)
+        etag = f"{crc:08x}-{len(parts)}"
+        await self.io.omap_set(_index_oid(bucket), {
+            key: json.dumps({"size": total, "etag": etag}).encode()})
+        for oid in parts:
+            try:
+                await self.io.remove(oid)
+            except ObjectNotFound:
+                pass
+        await self.io.remove(self._upload_meta_oid(upload_id))
+        xml = (f"<CompleteMultipartUploadResult>"
+               f"<Bucket>{escape(bucket)}</Bucket>"
+               f"<Key>{escape(key)}</Key>"
+               f"<ETag>&quot;{etag}&quot;</ETag>"
+               f"</CompleteMultipartUploadResult>")
+        return 200, {"Content-Type": "application/xml"}, xml.encode()
+
+    async def _abort_multipart(self, bucket: str, key: str,
+                               upload_id: str) -> tuple[int, dict, bytes]:
+        meta = await self._load_upload(upload_id)
+        if meta is None or (meta["bucket"], meta["key"]) != (bucket, key):
+            return 404, {}, b"NoSuchUpload"
+        for oid in await self._upload_parts(upload_id):
+            try:
+                await self.io.remove(oid)
+            except ObjectNotFound:
+                pass
+        await self.io.remove(self._upload_meta_oid(upload_id))
+        return 204, {}, b""
+
+
+_REASON = {200: "OK", 204: "No Content", 400: "Bad Request",
+           404: "Not Found", 405: "Method Not Allowed", 409: "Conflict",
            500: "Internal Server Error"}

@@ -23,10 +23,11 @@ def run(coro):
 
 
 async def _drive(c, cl, io, ec_pool, seed, n_ops, thrash=True,
-                 min_kills=2, max_seconds=45.0):
+                 min_kills=2, max_seconds=45.0, enable_snaps=False):
     import asyncio
     rng = random.Random(seed)
-    runner = ModelRunner(io, rng, ec_pool=ec_pool)
+    runner = ModelRunner(io, rng, ec_pool=ec_pool,
+                         enable_snaps=enable_snaps)
     thrasher = Thrasher(c, random.Random(seed + 1), max_down=1,
                         min_interval=0.4, max_interval=1.2)
     if thrash:
@@ -116,6 +117,33 @@ def test_model_no_thrash_is_exact(tmp_path):
                                      thrash=False)
             assert runner.uncertain_ops == 0
             assert not runner.uncertain
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_model_with_snapshots_thrashed(tmp_path):
+    """Random writes interleaved with self-managed snapshot create/
+    remove/read-at-snap while OSDs die and revive: every live
+    snapshot's full state must survive to the final check (clones ride
+    recovery pushes)."""
+    from ceph_tpu.objectstore import BlueStore
+    factory = lambda i: BlueStore(str(tmp_path / f"osd{i}"))  # noqa: E731
+
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=3, store_factory=factory)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("snapmodel", pg_num=8, size=3,
+                                 min_size=1)
+            io = cl.ioctx("snapmodel")
+            runner, thrasher = await _drive(
+                c, cl, io, ec_pool=False, seed=4242, n_ops=250,
+                enable_snaps=True)
+            assert thrasher.kills >= 1
+            assert runner.snap_ops >= 3, \
+                f"snapshot ops never exercised ({runner.snap_ops})"
         finally:
             await c.stop()
     run(body())

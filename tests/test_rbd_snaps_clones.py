@@ -111,6 +111,35 @@ def test_clone_copyup_flatten(tmp_path):
     run(body())
 
 
+def test_snap_of_clone_survives_flatten(tmp_path):
+    """A snapshot taken on an un-flattened clone pins its parent link:
+    after flatten, reads at that snap still show the parent's bytes
+    where the child had no objects."""
+    async def body():
+        c, io = await _cluster(tmp_path)
+        try:
+            await RBD.create(io, "p2", 2 * MB, order=20)
+            parent = await Image.open(io, "p2")
+            await parent.write(0, b"B" * 600)
+            await parent.snap_create("base")
+            await RBD.clone(io, "p2", "base", "c2")
+            child = await Image.open(io, "c2")
+            # snap while object 0 still falls through to the parent
+            await child.snap_create("before-flatten")
+            await child.flatten()
+            await child.write(0, b"N" * 600)     # head diverges
+            at_snap = await Image.open(io, "c2",
+                                       snap_name="before-flatten")
+            assert await at_snap.read(0, 600) == b"B" * 600
+            assert await child.read(0, 600) == b"N" * 600
+            await at_snap.close()
+            await child.close()
+            await parent.close()
+        finally:
+            await c.stop()
+    run(body())
+
+
 def test_exclusive_lock(tmp_path):
     async def body():
         c, io = await _cluster(tmp_path)

@@ -81,3 +81,69 @@ def test_s3_surface_end_to_end(tmp_path):
         finally:
             await c.stop()
     run(body())
+
+
+def test_multipart_upload(tmp_path):
+    """Initiate -> parts -> complete assembles the object in part order
+    and reclaims part objects; abort reclaims without assembling
+    (RGWInitMultipart/RGWCompleteMultipart behavior)."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rgw2", pg_num=4, size=3)
+            io = cl.ioctx("rgw2")
+            gw = RGWGateway(io)
+            addr = await gw.start()
+            try:
+                assert (await asyncio.to_thread(
+                    _req, addr, "PUT", "/vids"))[0] == 200
+                code, _, body_ = await asyncio.to_thread(
+                    _req, addr, "POST", "/vids/movie.bin?uploads", b"")
+                assert code == 200
+                upload_id = body_.decode().split(
+                    "<UploadId>")[1].split("</UploadId>")[0]
+
+                parts = [b"AA" * 4000, b"BB" * 3000, b"CC" * 2000]
+                # upload out of order: completion must sort by number
+                for n in (2, 1, 3):
+                    code, hdrs, _ = await asyncio.to_thread(
+                        _req, addr, "PUT",
+                        f"/vids/movie.bin?partNumber={n}"
+                        f"&uploadId={upload_id}", parts[n - 1])
+                    assert code == 200 and hdrs.get("ETag")
+
+                code, _, body_ = await asyncio.to_thread(
+                    _req, addr, "POST",
+                    f"/vids/movie.bin?uploadId={upload_id}", b"")
+                assert code == 200 and b"-3" in body_
+                code, _, got = await asyncio.to_thread(
+                    _req, addr, "GET", "/vids/movie.bin")
+                assert code == 200 and got == b"".join(parts)
+                # parts + meta were reclaimed
+                leftovers = [o for o in await io.list_objects()
+                             if o.startswith(".mp.")]
+                assert leftovers == []
+
+                # abort path
+                code, _, body_ = await asyncio.to_thread(
+                    _req, addr, "POST", "/vids/tmp.bin?uploads", b"")
+                uid2 = body_.decode().split(
+                    "<UploadId>")[1].split("</UploadId>")[0]
+                await asyncio.to_thread(
+                    _req, addr, "PUT",
+                    f"/vids/tmp.bin?partNumber=1&uploadId={uid2}",
+                    b"junk")
+                assert (await asyncio.to_thread(
+                    _req, addr, "DELETE",
+                    f"/vids/tmp.bin?uploadId={uid2}"))[0] == 204
+                assert [o for o in await io.list_objects()
+                        if o.startswith(".mp.")] == []
+                assert (await asyncio.to_thread(
+                    _req, addr, "GET", "/vids/tmp.bin"))[0] == 404
+            finally:
+                await gw.stop()
+        finally:
+            await c.stop()
+    run(body())
