@@ -1,0 +1,101 @@
+"""rbd_cli + cephfs_shell tool tests: drive the CLIs' _run entry
+against a live cluster (the reference's qa rbd/cephfs workunit tier).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from ceph_tpu.mds import MDSDaemon
+from ceph_tpu.tools import cephfs_shell, rbd_cli
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+
+
+def _args(mon, cmd, pool="rbd", mds=None, order=0):
+    ns = argparse.Namespace(mon=mon, pool=pool, cmd=cmd, order=order)
+    if mds is not None:
+        ns.mds = mds
+    return ns
+
+
+def test_rbd_cli_lifecycle(tmp_path, capsys):
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=8, size=3)
+            mon = "%s:%d" % c.mon_addrs[0]
+
+            assert await rbd_cli._run(
+                _args(mon, ["create", "disk", "2"])) == 0
+            assert await rbd_cli._run(_args(mon, ["ls"])) == 0
+            assert "disk" in capsys.readouterr().out
+
+            src = tmp_path / "payload.bin"
+            src.write_bytes(b"IMG" * 5000)
+            assert await rbd_cli._run(
+                _args(mon, ["import", str(src), "imported"])) == 0
+            dst = tmp_path / "out.bin"
+            assert await rbd_cli._run(
+                _args(mon, ["export", "imported", str(dst)])) == 0
+            assert dst.read_bytes() == b"IMG" * 5000
+
+            assert await rbd_cli._run(
+                _args(mon, ["snap", "create", "imported@v1"])) == 0
+            assert await rbd_cli._run(
+                _args(mon, ["clone", "imported@v1", "copy"])) == 0
+            assert await rbd_cli._run(
+                _args(mon, ["flatten", "copy"])) == 0
+            assert await rbd_cli._run(
+                _args(mon, ["snap", "ls", "imported"])) == 0
+            assert "v1" in capsys.readouterr().out
+            assert await rbd_cli._run(
+                _args(mon, ["snap", "rm", "imported@v1"])) == 0
+            assert await rbd_cli._run(_args(mon, ["rm", "copy"])) == 0
+            assert await rbd_cli._run(_args(mon, ["info", "disk"])) == 0
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_cephfs_shell(tmp_path, capsys):
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("cephfs_metadata", pg_num=8, size=3)
+            await cl.pool_create("cephfs_data", pg_num=8, size=3)
+            mds = MDSDaemon(c.mon_addrs)
+            await mds.start()
+            try:
+                mon = "%s:%d" % c.mon_addrs[0]
+                mdsa = "%s:%d" % mds.addr
+
+                def a(cmd):
+                    return _args(mon, cmd, mds=mdsa)
+
+                assert await cephfs_shell._run(a(["mkdir", "/docs"])) == 0
+                src = tmp_path / "in.txt"
+                src.write_bytes(b"hello fs cli")
+                assert await cephfs_shell._run(
+                    a(["put", str(src), "/docs/in.txt"])) == 0
+                assert await cephfs_shell._run(
+                    a(["cat", "/docs/in.txt"])) == 0
+                assert "hello fs cli" in capsys.readouterr().out
+                assert await cephfs_shell._run(a(["ls", "/docs"])) == 0
+                assert "in.txt" in capsys.readouterr().out
+                assert await cephfs_shell._run(
+                    a(["mv", "/docs/in.txt", "/docs/renamed.txt"])) == 0
+                assert await cephfs_shell._run(
+                    a(["stat", "/docs/renamed.txt"])) == 0
+                assert await cephfs_shell._run(
+                    a(["rm", "/docs/renamed.txt"])) == 0
+                assert await cephfs_shell._run(a(["rmdir", "/docs"])) == 0
+            finally:
+                await mds.stop()
+        finally:
+            await c.stop()
+    run(body())
