@@ -72,8 +72,20 @@ class MgrDaemon:
         if self._exporter_port is not None:
             async def health_cb() -> dict:
                 return self.health
+
+            async def status_cb() -> dict:
+                try:
+                    status = await self.mon_command({"prefix": "status"})
+                except Exception:
+                    status = {}
+                try:
+                    status["modules"] = self.module_status()
+                except Exception as e:
+                    status["modules"] = {"error": str(e)}
+                return status
             self.exporter = MetricsExporter(
-                port=self._exporter_port, health_cb=health_cb)
+                port=self._exporter_port, health_cb=health_cb,
+                status_cb=status_cb)
             await self.exporter.start()
         self._tick_task = asyncio.get_running_loop().create_task(
             self._tick_loop())

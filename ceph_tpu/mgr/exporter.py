@@ -61,13 +61,60 @@ def render_metrics(health: dict | None = None) -> str:
     return "\n".join(out) + "\n"
 
 
+def render_dashboard(status: dict, health: dict | None) -> str:
+    """Read-only cluster dashboard (one self-contained HTML page).
+    Every cluster-supplied string is escaped: pool names and health
+    summaries are attacker-influencable."""
+    import html as _html
+    esc = _html.escape
+    h = health or status.get("health") or {}
+    hstat = esc(str(h.get("status", "UNKNOWN")))
+    color = {"HEALTH_OK": "#2a2", "HEALTH_WARN": "#d90",
+             "HEALTH_ERR": "#c22"}.get(h.get("status"), "#888")
+    rows = []
+    for name, p in sorted((status.get("pools") or {}).items()):
+        rows.append(f"<tr><td>{esc(str(name))}</td>"
+                    f"<td>{esc(str(p.get('type', '')))}</td>"
+                    f"<td>{esc(str(p.get('size', '')))}</td>"
+                    f"<td>{esc(str(p.get('pg_num', '')))}</td></tr>")
+    checks = []
+    for cname, chk in (h.get("checks") or {}).items():
+        checks.append(f"<li><b>{esc(str(cname))}</b> "
+                      f"[{esc(str(chk.get('severity')))}]: "
+                      f"{esc(str(chk.get('summary')))}</li>")
+    om = status.get("osdmap") or {}
+    mods = esc(json.dumps(status.get("modules", {}), indent=1))
+    return f"""<!doctype html><html><head><title>ceph-tpu dashboard</title>
+<style>body{{font-family:monospace;margin:2em}}
+table{{border-collapse:collapse}}td,th{{border:1px solid #ccc;
+padding:4px 10px}}.pill{{color:#fff;background:{color};
+padding:2px 10px;border-radius:9px}}</style></head><body>
+<h1>ceph-tpu <span class="pill">{hstat}</span></h1>
+<p>osdmap epoch {om.get('epoch', '?')} &middot;
+{om.get('num_up_osds', '?')}/{om.get('num_osds', '?')} osds up &middot;
+mons {', '.join(str(q) for q in
+                (status.get('monmap') or {}).get('quorum', []))}</p>
+<ul>{''.join(checks) or '<li>no active health checks</li>'}</ul>
+<h2>pools</h2>
+<table><tr><th>pool</th><th>type</th><th>size</th><th>pg_num</th></tr>
+{''.join(rows)}</table>
+<h2>mgr modules</h2><pre>{mods}</pre>
+<p><a href="/metrics">metrics</a> &middot;
+<a href="/status.json">status.json</a></p></body></html>"""
+
+
 class MetricsExporter:
-    """Serve /metrics (prometheus text) and /health (JSON)."""
+    """Serve /metrics (prometheus text), /health (JSON), and — when a
+    status callback is wired — / as a dashboard-lite HTML page plus
+    /status.json (the mgr dashboard module's role,
+    src/pybind/mgr/dashboard, collapsed to a read-only status page)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 health_cb: Callable[[], Awaitable[dict]] | None = None):
+                 health_cb: Callable[[], Awaitable[dict]] | None = None,
+                 status_cb: Callable[[], Awaitable[dict]] | None = None):
         self.host, self.port = host, port
         self.health_cb = health_cb
+        self.status_cb = status_cb
         self._server: asyncio.Server | None = None
         self.addr: tuple[str, int] | None = None
 
@@ -83,6 +130,15 @@ class MetricsExporter:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+
+    async def _safe_status(self) -> dict:
+        """status_cb degrades like health_cb: a failing module must
+        produce an error page, not a reset connection."""
+        try:
+            return await self.status_cb()
+        except Exception as e:
+            dout("mgr", 2, f"status callback failed: {e}")
+            return {"error": f"{type(e).__name__}: {e}"}
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
@@ -108,8 +164,19 @@ class MetricsExporter:
                 body = json.dumps(health or {}).encode()
                 ctype = "application/json"
                 code = "200 OK"
+            elif path.startswith("/status.json") and \
+                    self.status_cb is not None:
+                body = json.dumps(await self._safe_status()).encode()
+                ctype = "application/json"
+                code = "200 OK"
+            elif path in ("/", "/index.html") and \
+                    self.status_cb is not None:
+                body = render_dashboard(await self._safe_status(),
+                                        health).encode()
+                ctype = "text/html; charset=utf-8"
+                code = "200 OK"
             else:
-                body = b"try /metrics or /health\n"
+                body = b"try /metrics, /health, /status.json or /\n"
                 ctype = "text/plain"
                 code = "404 Not Found"
             writer.write(

@@ -724,6 +724,12 @@ class Monitor(Dispatcher):
                     "epoch": om.osdmap.epoch}
         if prefix in ("osd out", "osd in", "osd down"):
             ids = [int(i) for i in cmd.get("ids", [])]
+            unknown = [i for i in ids if i not in om.osdmap.osds]
+            if unknown:
+                # an unknown id must never enter paxos: the committed
+                # incremental would KeyError on every map applier,
+                # permanently wedging the map plane
+                raise ValueError(f"osd ids {unknown} do not exist")
             pending = om.get_pending()
             for osd in ids:
                 {"osd out": pending.new_out, "osd down": pending.new_down,

@@ -19,6 +19,9 @@ Commands:
     status                  cluster status (ceph -s)
     health                  health checks (ceph health)
     df                      per-pool object counts
+    osd tree|dump           osd hierarchy / full map (ceph osd ...)
+    osd out|in|down ID...   osd state admin
+    pg                      per-PG up/acting dump (ceph pg dump)
 """
 from __future__ import annotations
 
@@ -58,6 +61,36 @@ async def _run(args) -> int:
             for name in sorted(client.osdmap.pool_names):
                 objs = await client.ioctx(name).list_objects()
                 print(f"{name}\t{len(objs)} objects")
+        elif cmd == "osd":
+            # `ceph osd ...` admin plane (src/ceph.in verbs)
+            sub = args.cmd[1]
+            if sub == "tree":
+                out = await client.command({"prefix": "osd tree"})
+                for bname, b in sorted(out["buckets"].items()):
+                    print(f"{b['type']}\t{bname}")
+                    for item, w in zip(b["items"], b["weights"]):
+                        label = f"osd.{item}" if item >= 0 else f"#{item}"
+                        print(f"\t{label}\tweight {w}")
+            elif sub == "dump":
+                out = await client.command({"prefix": "osd dump"})
+                print(json.dumps(out, indent=1))
+            elif sub in ("out", "in", "down"):
+                ids = [int(i) for i in args.cmd[2:]]
+                out = await client.command(
+                    {"prefix": f"osd {sub}", "ids": ids})
+                print(json.dumps(out))
+            else:
+                print(f"unknown osd subcommand {sub!r}", file=sys.stderr)
+                return 2
+        elif cmd == "pg":
+            # `ceph pg dump`-lite: per-PG acting sets from the map
+            from ceph_tpu.crush.osdmap import PG as PGId
+            for name in sorted(client.osdmap.pool_names):
+                pool = client.osdmap.get_pool(name)
+                for ps in range(pool.pg_num):
+                    up, acting = client.osdmap.pg_to_up_acting_osds(
+                        PGId(pool.id, ps))
+                    print(f"{pool.id}.{ps:x}\tup {up}\tacting {acting}")
         else:
             if not args.pool:
                 print("error: -p POOL required", file=sys.stderr)
@@ -107,7 +140,13 @@ def main(argv=None) -> int:
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--object-size", type=int, default=65536)
     ap.add_argument("cmd", nargs="+")
-    return asyncio.run(_run(ap.parse_args(argv)))
+    args = ap.parse_args(argv)
+    try:
+        return asyncio.run(_run(args))
+    except IndexError:
+        print(f"error: missing operand for {' '.join(args.cmd)!r} "
+              f"(see --help)", file=sys.stderr)
+        return 2
 
 
 if __name__ == "__main__":

@@ -157,7 +157,13 @@ def main(argv=None) -> int:
     p.add_argument("--order", type=int, default=0)
     p.add_argument("cmd", nargs="+")
     args = p.parse_args(argv)
-    return asyncio.run(asyncio.wait_for(_run(args), 120))
+    try:
+        return asyncio.run(asyncio.wait_for(_run(args), 120))
+    except IndexError:
+        # missing operand for a subcommand: usage error, not a traceback
+        print(f"error: missing operand for {' '.join(args.cmd)!r} "
+              f"(see --help)", file=sys.stderr)
+        return 2
 
 
 if __name__ == "__main__":

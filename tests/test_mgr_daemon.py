@@ -57,6 +57,16 @@ def test_mgr_modules_and_endpoint(tmp_path):
                 metrics = (await _http_get(mgr.exporter.addr,
                                            "/metrics")).decode()
                 assert "ceph_health_status" in metrics
+
+                # dashboard-lite: HTML page + status.json
+                page = (await _http_get(mgr.exporter.addr,
+                                        "/")).decode()
+                assert "ceph-tpu" in page and "mp" in page \
+                    and mgr.health["status"] in page
+                sj = json.loads(await _http_get(mgr.exporter.addr,
+                                                "/status.json"))
+                assert "mp" in sj["pools"]
+                assert "pg_autoscaler" in sj["modules"]
             finally:
                 await mgr.stop()
         finally:

@@ -136,6 +136,12 @@ def test_rados_cli_round_trip(tmp_path):
             assert await asyncio.to_thread(
                 run_cli, "-p", "cli-pool", "rm", "obj1") == 0
             assert await asyncio.to_thread(run_cli, "df") == 0
+            # ceph osd / pg admin plane
+            assert await asyncio.to_thread(run_cli, "osd", "tree") == 0
+            assert await asyncio.to_thread(run_cli, "osd", "dump") == 0
+            assert await asyncio.to_thread(run_cli, "pg") == 0
+            assert await asyncio.to_thread(run_cli, "osd", "out", "2") == 0
+            assert await asyncio.to_thread(run_cli, "osd", "in", "2") == 0
         finally:
             await c.stop()
     run(body())
