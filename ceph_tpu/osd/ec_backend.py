@@ -175,14 +175,43 @@ class ECBackend(PGBackend):
             padded = self._pad(data)
             shards = ec_util.encode(self.sinfo, self.ec_impl, padded) \
                 if padded else {i: b"" for i in range(self.n)}
+            # WRITEFULL replaces data, not xattrs: the full-state shard
+            # rewrite must carry the user attrs forward (the primary's
+            # copy is authoritative — xattrs replicate to every shard)
+            uattrs = self._local_user_attrs(oid)
             payloads = {
                 i: ({"op": "write_full",
-                     "attrs": self._encode_attrs(self._chunk_attrs(
+                     "attrs": self._encode_attrs({**self._chunk_attrs(
                          i, len(data), entry.version,
-                         self._csums(shards[i])))}, shards[i])
+                         self._csums(shards[i])), **uattrs})},
+                    shards[i])
                 for i in live}
         elif op in ("delete", "remove"):
             payloads = {i: ({"op": "delete"}, b"") for i in live}
+        elif op == "setxattr":
+            kv = json.loads(data)
+            size, ver = await self._current_state(oid)
+            if tuple(ver) == (0, 0):
+                # xattr-on-absent creates the object: ONE sub-op writes
+                # empty shards carrying the attr, atomically under this
+                # object's lock (a separate exists-check + create would
+                # race a concurrent data write)
+                uat = {"u:" + kv["name"]: kv["value"].encode("latin1"),
+                       **self._local_user_attrs(oid)}
+                payloads = {
+                    i: ({"op": "write_full",
+                         "attrs": self._encode_attrs({
+                             **self._chunk_attrs(i, 0, entry.version,
+                                                 self._csums(b"")),
+                             **uat})}, b"")
+                    for i in live}
+            else:
+                payloads = {i: ({"op": "setxattr", "name": kv["name"],
+                                 "value": kv["value"]}, b"")
+                            for i in live}
+        elif op == "rmxattr":
+            payloads = {i: ({"op": "rmxattr",
+                             "name": data.decode()}, b"") for i in live}
         elif op in ("write", "append"):
             payloads = await self._plan_rmw(oid, op, off, data, entry, live)
             if payloads is None:        # zero-length no-op past the plan
@@ -249,6 +278,17 @@ class ECBackend(PGBackend):
                             "shard": i,
                             "version": list(entry.version)}, shards[i])
         return payloads
+
+    def _local_user_attrs(self, oid: str) -> dict[str, bytes]:
+        """This OSD's copy of the object's user xattrs (replicated onto
+        every shard, so any live holder — the primary included — is an
+        authoritative source)."""
+        try:
+            attrs = self.host.store.getattrs(self.coll(),
+                                             self.ghobject(oid))
+        except StoreError:
+            return {}
+        return {k: v for k, v in attrs.items() if k.startswith("u:")}
 
     async def _current_state(self, oid: str) -> tuple[int, tuple]:
         """(logical size, version) of the object, 0/(0,0) if absent."""
@@ -323,6 +363,13 @@ class ECBackend(PGBackend):
             self.local_apply(oid, "push", chunk, attrs=attrs)
         elif kind == "extent_write":
             self._apply_extent(oid, sub, chunk)
+        elif kind == "setxattr":
+            # user xattrs replicate onto EVERY shard (the reference
+            # stores object attrs alongside each shard the same way)
+            self.local_apply(oid, "setxattr", json.dumps(
+                {"name": sub["name"], "value": sub["value"]}).encode())
+        elif kind == "rmxattr":
+            self.local_apply(oid, "rmxattr", sub["name"].encode())
         elif kind == "delete":
             self.local_apply(oid, "delete", b"")
         else:
@@ -393,9 +440,13 @@ class ECBackend(PGBackend):
         """
         # per observed version: {shard: (extent, ec_size)}
         by_version: dict[tuple, dict[int, tuple]] = {}
+        uattrs_by: dict[tuple, dict] = {}
 
-        def add(shard: int, data: bytes, size: int, ver) -> None:
+        def add(shard: int, data: bytes, size: int, ver,
+                uattrs: dict | None = None) -> None:
             by_version.setdefault(tuple(ver), {})[shard] = (data, size)
+            if uattrs:
+                uattrs_by.setdefault(tuple(ver), {}).update(uattrs)
 
         def best() -> tuple | None:
             for ver in sorted(by_version, reverse=True):
@@ -407,7 +458,9 @@ class ECBackend(PGBackend):
             loc = self._verified_local_extent(oid, chunk_off, chunk_len)
             if loc is not None:
                 data, shard, size, ver = loc
-                add(shard, data, size, ver)
+                add(shard, data, size, ver,
+                    {k[2:]: v.decode("latin1") for k, v in
+                     self._local_user_attrs(oid).items()})
 
         # two rounds: ask a minimum set first (k shards total, preferring
         # data positions), top up with the remaining positions only when
@@ -481,7 +534,8 @@ class ECBackend(PGBackend):
                     payload, data = fut.result()
                     if payload.get("found"):
                         add(payload["shard"], data, payload["ec_size"],
-                            payload.get("version", (0, 0)))
+                            payload.get("version", (0, 0)),
+                            payload.get("uattrs"))
         finally:
             for fut, tid in waits.items():
                 fut.cancel()
@@ -523,7 +577,8 @@ class ECBackend(PGBackend):
         got = {shard: data for shard, (data, _) in shards.items()}
         any_shard = next(iter(shards.values()))
         return got, any_shard[1], {"version": ver,
-                                   "rolled_back": rolled_back}
+                                   "rolled_back": rolled_back,
+                                   "uattrs": uattrs_by.get(ver, {})}
 
     async def _gather_prev_pass(self, oid: str, exclude_osds: frozenset,
                                 chunk_off: int, chunk_len: int,
@@ -567,7 +622,8 @@ class ECBackend(PGBackend):
                     payload, data = fut.result()
                     if payload.get("found"):
                         add(payload["shard"], data, payload["ec_size"],
-                            payload.get("version", (0, 0)))
+                            payload.get("version", (0, 0)),
+                            payload.get("uattrs"))
         finally:
             for fut, tid in waits.items():
                 fut.cancel()
@@ -647,7 +703,11 @@ class ECBackend(PGBackend):
         if loc is not None:
             data, shard, size, ver = loc
             payload.update({"found": True, "shard": shard,
-                            "ec_size": size, "version": list(ver)})
+                            "ec_size": size, "version": list(ver),
+                            "uattrs": {k[2:]: v.decode("latin1")
+                                       for k, v in
+                                       self._local_user_attrs(
+                                           p["oid"]).items()}})
             self.sub_read_bytes_served += len(data)
         conn.send_message(MOSDECSubOpReadReply(payload, data))
 
@@ -706,8 +766,11 @@ class ECBackend(PGBackend):
         else:
             chunk = ec_util.decode_shards(self.sinfo, self.ec_impl,
                                           got, [idx])[idx]
-        return chunk, self._chunk_attrs(idx, ec_size, meta["version"],
-                                        self._csums(chunk))
+        attrs = self._chunk_attrs(idx, ec_size, meta["version"],
+                                  self._csums(chunk))
+        for name, val in meta.get("uattrs", {}).items():
+            attrs["u:" + name] = val.encode("latin1")
+        return chunk, attrs
 
     async def push_object(self, peer: int, oid: str) -> None:
         """Reconstruct `peer`'s positional chunk from k survivors and

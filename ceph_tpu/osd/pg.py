@@ -751,14 +751,14 @@ class PGInstance:
                          "zero", "create", "delete", "setxattr", "rmxattr",
                          "omap_set", "omap_rm", "rollback", "snaptrim"})
     # the reference rejects omap on EC pools (PrimaryLogPG.cc
-    # pool.info.supports_omap()); truncate/zero/xattr need machinery our
-    # EC backend does not carry per shard yet, so they are gated the
-    # same way (divergence: the reference allows xattrs + truncate on
-    # EC; snapshots require replicated pools here, like pre-overwrite
-    # EC in the reference)
-    EC_UNSUPPORTED = frozenset({"truncate", "zero", "setxattr", "rmxattr",
+    # pool.info.supports_omap()); truncate/zero need shrink machinery
+    # our EC stripe driver does not carry yet (divergence: the
+    # reference allows truncate on EC; snapshots require replicated
+    # pools here, like pre-overwrite EC in the reference). User xattrs
+    # replicate onto every shard, like the reference.
+    EC_UNSUPPORTED = frozenset({"truncate", "zero",
                                 "omap_set", "omap_rm", "omap_get",
-                                "omap_vals", "getxattr", "getxattrs",
+                                "omap_vals",
                                 "rollback", "snaptrim", "list_snaps"})
 
     async def do_op(self, op: dict, data: bytes,

@@ -30,7 +30,23 @@ import sys
 from ceph_tpu.mds import CephFS
 
 
+MIN_OPERANDS = {"ls": 0, "mkdir": 1, "rmdir": 1, "put": 2, "get": 2,
+                "cat": 1, "rm": 1, "mv": 2, "stat": 1, "du": 0}
+
+
+def _check_operands(cmd: list[str]) -> str | None:
+    if cmd[0] not in MIN_OPERANDS:
+        return f"unknown command {cmd[0]!r}"
+    if len(cmd) - 1 < MIN_OPERANDS[cmd[0]]:
+        return f"missing operand for {' '.join(cmd)!r} (see --help)"
+    return None
+
+
 async def _run(args) -> int:
+    err = _check_operands(args.cmd)
+    if err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
     mon_host, mon_port = args.mon.rsplit(":", 1)
     mds_host, mds_port = args.mds.rsplit(":", 1)
     fs = CephFS([(mon_host, int(mon_port))], (mds_host, int(mds_port)))
@@ -81,13 +97,7 @@ def main(argv=None) -> int:
     p.add_argument("--mds", required=True, help="mds HOST:PORT")
     p.add_argument("cmd", nargs="+")
     args = p.parse_args(argv)
-    try:
-        return asyncio.run(asyncio.wait_for(_run(args), 120))
-    except IndexError:
-        # missing operand for a subcommand: usage error, not a traceback
-        print(f"error: missing operand for {' '.join(args.cmd)!r} "
-              f"(see --help)", file=sys.stderr)
-        return 2
+    return asyncio.run(asyncio.wait_for(_run(args), 120))
 
 
 if __name__ == "__main__":

@@ -33,7 +33,24 @@ import sys
 from ceph_tpu.rados import RadosClient
 
 
+MIN_OPERANDS = {"ls": 0, "put": 2, "get": 2, "rm": 1, "stat": 1,
+                "bench": 1, "lspools": 0, "mkpool": 1, "status": 0,
+                "health": 0, "df": 0, "osd": 1, "pg": 0}
+
+
+def _check_operands(cmd: list[str]) -> str | None:
+    if cmd[0] not in MIN_OPERANDS:
+        return f"unknown command {cmd[0]!r}"
+    if len(cmd) - 1 < MIN_OPERANDS[cmd[0]]:
+        return f"missing operand for {' '.join(cmd)!r} (see --help)"
+    return None
+
+
 async def _run(args) -> int:
+    err = _check_operands(args.cmd)
+    if err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
     host, port = args.mon.rsplit(":", 1)
     client = RadosClient([(host, int(port))])
     await client.connect()
@@ -141,12 +158,7 @@ def main(argv=None) -> int:
     ap.add_argument("--object-size", type=int, default=65536)
     ap.add_argument("cmd", nargs="+")
     args = ap.parse_args(argv)
-    try:
-        return asyncio.run(_run(args))
-    except IndexError:
-        print(f"error: missing operand for {' '.join(args.cmd)!r} "
-              f"(see --help)", file=sys.stderr)
-        return 2
+    return asyncio.run(_run(args))
 
 
 if __name__ == "__main__":

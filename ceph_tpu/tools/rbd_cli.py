@@ -44,7 +44,29 @@ def _split_at(spec: str) -> tuple[str, str]:
     return name, snap
 
 
+#: operands each command requires AFTER the command word
+MIN_OPERANDS = {"create": 2, "ls": 0, "info": 1, "rm": 1, "resize": 2,
+                "export": 2, "import": 2, "snap": 2, "clone": 2,
+                "flatten": 1, "lock": 2}
+
+
+def _check_operands(cmd: list[str], table: dict[str, int]) -> str | None:
+    if cmd[0] not in table:
+        return f"unknown command {cmd[0]!r}"
+    if len(cmd) - 1 < table[cmd[0]]:
+        return f"missing operand for {' '.join(cmd)!r} (see --help)"
+    return None
+
+
 async def _run(args) -> int:
+    err = _check_operands(args.cmd, MIN_OPERANDS)
+    if err:
+        print(f"error: {err}", file=sys.stderr)
+        return 2
+    if args.cmd[0] == "snap" and args.cmd[1] == "ls" \
+            and len(args.cmd) < 3:
+        print("error: snap ls needs an image name", file=sys.stderr)
+        return 2
     host, port = args.mon.rsplit(":", 1)
     client = RadosClient([(host, int(port))])
     await client.connect()
@@ -157,13 +179,7 @@ def main(argv=None) -> int:
     p.add_argument("--order", type=int, default=0)
     p.add_argument("cmd", nargs="+")
     args = p.parse_args(argv)
-    try:
-        return asyncio.run(asyncio.wait_for(_run(args), 120))
-    except IndexError:
-        # missing operand for a subcommand: usage error, not a traceback
-        print(f"error: missing operand for {' '.join(args.cmd)!r} "
-              f"(see --help)", file=sys.stderr)
-        return 2
+    return asyncio.run(asyncio.wait_for(_run(args), 120))
 
 
 if __name__ == "__main__":

@@ -192,6 +192,47 @@ def test_rename_edge_cases(tmp_path):
     run(body())
 
 
+def test_cephfs_survives_osd_thrashing(tmp_path):
+    """Files written while OSDs die and revive: the MDS's own RADOS
+    client and the mount's data-path ops all retry across failovers;
+    everything written must read back after the cluster heals."""
+    async def body():
+        from ceph_tpu.qa.rados_model import Thrasher
+        import random
+        c = FSHarness(tmp_path)
+        try:
+            await c.start()
+            await c.start_fs()
+            fs = await c.mount()
+            th = Thrasher(c, random.Random(7), max_down=1,
+                          min_interval=0.5, max_interval=1.5)
+            th.start()
+            payloads = {}
+            try:
+                await fs.mkdir("/thrash")
+                i = 0
+                deadline = asyncio.get_running_loop().time() + 30
+                while (th.kills < 2 or i < 12) and \
+                        asyncio.get_running_loop().time() < deadline:
+                    blob = os.urandom(3 * 4096 + i * 7)
+                    path = f"/thrash/f{i:03d}"
+                    await fs.write_file(path, blob)
+                    payloads[path] = blob
+                    i += 1
+            finally:
+                await th.stop()
+            await asyncio.sleep(2.0)      # heal
+            names = await fs.readdir("/thrash")
+            assert sorted(names) == sorted(
+                p.rsplit("/", 1)[1] for p in payloads)
+            for path, blob in payloads.items():
+                assert await fs.read_file(path) == blob, path
+            assert th.kills >= 2
+        finally:
+            await c.stop()
+    run(body())
+
+
 def test_two_mounts_see_each_other(tmp_path):
     async def body():
         c = FSHarness(tmp_path)

@@ -261,9 +261,10 @@ def test_ec_pool_rejects_unsupported_ops(tmp_path):
         c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3)
         try:
             await io.write_full("o", b"data")
+            # xattrs are supported on EC now (reference parity);
+            # truncate/zero/omap remain gated
             for coro in (io.truncate("o", 1), io.zero("o", 0, 1),
-                         io.omap_set("o", {"k": b"v"}),
-                         io.setxattr("o", "a", b"b")):
+                         io.omap_set("o", {"k": b"v"})):
                 with pytest.raises(RadosError) as ei:
                     await coro
                 assert ei.value.rc == -95
@@ -284,6 +285,110 @@ def test_ec_delete_and_recreate_via_rmw(tmp_path):
                 await io.read("d")
             await io.append("d", b"xyz")
             assert await io.read("d") == b"xyz"
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_ec_user_xattrs(tmp_path):
+    """User xattrs on EC pools replicate to every shard and survive a
+    shard holder dying (reference: attrs stored alongside each shard)."""
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=4)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.command({"prefix": "osd erasure-code-profile set",
+                              "name": "x22",
+                              "profile": {"plugin": "tpu", "k": "2",
+                                          "m": "2"}})
+            await cl.pool_create("ecx", pg_num=4, pool_type="erasure",
+                                 erasure_code_profile="x22")
+            io = cl.ioctx("ecx")
+            await io.write_full("obj", b"payload" * 100)
+            await io.setxattr("obj", "owner", b"alice")
+            await io.setxattr("obj", "tier", b"hot")
+            assert await io.getxattr("obj", "owner") == b"alice"
+            attrs = await io.getxattrs("obj")
+            assert attrs == {"owner": b"alice", "tier": b"hot"}
+            await io.rmxattr("obj", "tier")
+            assert await io.getxattrs("obj") == {"owner": b"alice"}
+
+            # xattr on a nonexistent object creates it
+            await io.setxattr("fresh", "k", b"v")
+            assert await io.getxattr("fresh", "k") == b"v"
+            assert (await io.stat("fresh"))["size"] == 0
+
+            # survive a shard holder dying and the pg re-peering
+            import asyncio as _a
+            pgid = cl.osdmap.object_to_pg("ecx", "obj")
+            victim = cl.osdmap.primary(pgid)
+            await c.kill_osd(victim)
+            await c.wait_osd_down(victim)
+            assert await io.getxattr("obj", "owner") == b"alice"
+            assert await io.read("obj") == b"payload" * 100
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_ec_xattrs_survive_recovery_and_write_full(tmp_path):
+    """Reference invariants the review demanded: write_full preserves
+    user xattrs on EC pools, and a shard that was DOWN during setxattr
+    receives the attr via recovery push (and can serve it as primary)."""
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=4)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.command({"prefix": "osd erasure-code-profile set",
+                              "name": "xr22",
+                              "profile": {"plugin": "tpu", "k": "2",
+                                          "m": "2"}})
+            await cl.pool_create("ecxr", pg_num=1, pool_type="erasure",
+                                 erasure_code_profile="xr22")
+            io = cl.ioctx("ecxr")
+            await io.write_full("obj", b"v1" * 200)
+            await io.setxattr("obj", "before", b"yes")
+
+            # write_full must not wipe the attr (WRITEFULL semantics)
+            await io.write_full("obj", b"v2" * 300)
+            assert await io.getxattr("obj", "before") == b"yes"
+
+            # take one non-primary shard holder down; set an attr the
+            # downed shard never sees; revive; recovery must push it
+            from ceph_tpu.crush.osdmap import PG as PGId
+            pgid = cl.osdmap.object_to_pg("ecxr", "obj")
+            _, acting = cl.osdmap.pg_to_up_acting_osds(pgid)
+            victim = acting[-1]
+            await c.kill_osd(victim)
+            await c.wait_osd_down(victim)
+            await io.setxattr("obj", "while-down", b"set")
+            await io.write_full("obj", b"v3" * 250)
+            await c.start_osd(victim)
+            await asyncio.sleep(2.5)     # re-peer + recover
+            # force the recovered shard's OSD to answer: make it the
+            # only source of truth by killing the others' CLIENT view —
+            # simplest check: read attrs from the recovered OSD's store
+            osd = c.osds[victim]
+            pg = next(iter(osd.pgs.values()))
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                attrs = {}
+                try:
+                    attrs = osd.store.getattrs(pg.backend.coll(),
+                                               pg.backend.ghobject("obj"))
+                except Exception:
+                    pass
+                if attrs.get("u:while-down") == b"set" and \
+                        attrs.get("u:before") == b"yes":
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError(
+                        f"recovered shard lacks xattrs: "
+                        f"{sorted(attrs)}")
+                await asyncio.sleep(0.3)
+            assert await io.read("obj") == b"v3" * 250
         finally:
             await c.stop()
     run(body())
