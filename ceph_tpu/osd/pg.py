@@ -818,17 +818,28 @@ class PGInstance:
                     self.backend.coll(), self.backend.ghobject(oid),
                     "u:" + op["name"])
             except StoreError:
+                if self.pool.type == "erasure":
+                    # the primary's own chunk may be missing/degraded:
+                    # any live shard carries the replicated user attrs
+                    uattrs = await self._ec_gather_uattrs(oid)
+                    if uattrs is not None and op["name"] in uattrs:
+                        return 0, {}, uattrs[op["name"]].encode("latin1")
                 return -61, {"error": f"ENODATA: xattr {op['name']!r}"}, b""
             return 0, {}, val
         if kind == "getxattrs":
             try:
                 attrs = self.host.store.getattrs(
                     self.backend.coll(), self.backend.ghobject(oid))
+                xattrs = {k[2:]: v.decode("latin1")
+                          for k, v in attrs.items()
+                          if k.startswith("u:")}
             except StoreError as e:
+                if self.pool.type == "erasure":
+                    uattrs = await self._ec_gather_uattrs(oid)
+                    if uattrs is not None:
+                        return 0, {"xattrs": uattrs}, b""
                 return self._store_rc(e), {"error": str(e)}, b""
-            return 0, {"xattrs": {k[2:]: v.decode("latin1")
-                                  for k, v in attrs.items()
-                                  if k.startswith("u:")}}, b""
+            return 0, {"xattrs": xattrs}, b""
         if kind == "omap_get":
             try:
                 omap = self.host.store.omap_get(
@@ -1014,6 +1025,16 @@ class PGInstance:
         except ClassCallError as e:
             return e.rc, {"error": str(e)}, b""
         return 0, last, out or b""
+
+    async def _ec_gather_uattrs(self, oid: str) -> dict | None:
+        """User xattrs from any live shard (the degraded-primary path:
+        the local chunk is gone but >= k shards still exist)."""
+        try:
+            _, _, meta = await self.backend._gather_chunks(
+                oid, chunk_off=0, chunk_len=0)
+        except StoreError:
+            return None
+        return meta.get("uattrs", {})
 
     def _do_snap_read(self, kind: str, oid: str, op: dict,
                       snapid: int) -> tuple[int, dict, bytes]:

@@ -392,3 +392,36 @@ def test_ec_xattrs_survive_recovery_and_write_full(tmp_path):
         finally:
             await c.stop()
     run(body())
+
+
+def test_ec_xattr_read_with_degraded_primary_chunk(tmp_path):
+    """The acting primary's own positional chunk is missing, but xattr
+    reads still serve via the shard gather (any live shard carries the
+    replicated user attrs)."""
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=4)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.command({"prefix": "osd erasure-code-profile set",
+                              "name": "xd22",
+                              "profile": {"plugin": "tpu", "k": "2",
+                                          "m": "2"}})
+            await cl.pool_create("ecxd", pg_num=1, pool_type="erasure",
+                                 erasure_code_profile="xd22")
+            io = cl.ioctx("ecxd")
+            await io.write_full("obj", b"data" * 500)
+            await io.setxattr("obj", "k", b"v")
+            # surgically delete the PRIMARY's local chunk + attrs (the
+            # degraded-chunk state recovery would normally heal)
+            from ceph_tpu.crush.osdmap import PG as PGId
+            pgid = cl.osdmap.object_to_pg("ecxd", "obj")
+            primary = cl.osdmap.primary(pgid)
+            osd = c.osds[primary]
+            pg = next(iter(osd.pgs.values()))
+            pg.backend.local_apply("obj", "delete", b"")
+            assert await io.getxattr("obj", "k") == b"v"
+            assert (await io.getxattrs("obj")) == {"k": b"v"}
+        finally:
+            await c.stop()
+    run(body())
