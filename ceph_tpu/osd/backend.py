@@ -223,6 +223,13 @@ class PGBackend:
     async def execute_stat(self, oid: str) -> int:
         return self.object_size(oid)
 
+    async def verify_dup_committed(self, oid: str, version) -> bool:
+        """Whether a dup-index hit may be answered as done. The
+        replicated primary applies locally in the same event-loop slice
+        as the log append, so a logged entry is always applied here and
+        recovery rolls it forward — always answerable."""
+        return True
+
     # -- recovery hooks (PG peering calls these) -----------------------------
 
     def read_for_push(self, oid: str, shard: int = -1) -> tuple[bytes, dict]:
@@ -301,20 +308,16 @@ class ReplicatedBackend(PGBackend):
                  if o not in (CRUSH_NONE, self.host.whoami)}
         tid = self.new_tid()
         fut = self._start_waiting(tid, peers)
-        # local first (the primary is always a replica of itself) — and
-        # the LOG ENTRY lands atomically with the local apply, BEFORE
-        # any ack wait. If the op then fails mid-fan-out (interval
-        # change, primary loss), the applied data is never unlogged:
-        # the client's retry hits the dup index instead of re-executing
-        # against polluted local state (an unlogged applied APPEND made
-        # a retry resolve its offset one payload too far — found by the
-        # thrashing model checker). The reference writes pg log entries
-        # in the same ObjectStore transaction as the data for exactly
-        # this reason.
+        # local first (the primary is always a replica of itself). The
+        # caller logged the entry synchronously before this call, so a
+        # retry after ANY mid-fan-out failure dup-detects instead of
+        # re-executing against polluted local state (an unlogged
+        # applied APPEND made a retry resolve its offset one payload
+        # too far — found by the thrashing model checker). The
+        # reference writes pg log entries in the same ObjectStore
+        # transaction as the data for the same reason; here entry
+        # append + local apply run in one event-loop slice.
         self.local_apply(oid, op, data, off=off)
-        if entry.version > pg.log.head:
-            pg.log.append(entry)
-            pg.persist_meta()
         msg_payload = {
             "pgid": [pg.pgid.pool, pg.pgid.ps],
             "tid": tid,

@@ -290,6 +290,35 @@ class ECBackend(PGBackend):
             return {}
         return {k: v for k, v in attrs.items() if k.startswith("u:")}
 
+    async def verify_dup_committed(self, oid, version) -> bool:
+        """A dup hit is answerable only when the write is actually
+        READABLE at its version: an EC entry is logged before the shard
+        fan-out, so a failure can leave it applied on too few (or zero)
+        shards. ENOENT means a later delete committed — done. A gather
+        at an OLDER version means the write never landed — re-execute.
+        A gather at a NEWER version is AMBIGUOUS (the entry may have
+        been cleanly superseded, or may never have applied before the
+        later write): neither "done" nor re-execution is safe, so the
+        op errors out honestly and the client's model keeps both
+        outcomes. Gather EIO is the same ambiguity."""
+        try:
+            _, _, meta = await self._gather_chunks(oid, chunk_off=0,
+                                                   chunk_len=0)
+        except StoreError as e:
+            if e.code == "ENOENT":
+                return True
+            raise StoreError(
+                "EIO", f"{oid}: dup retry unverifiable ({e})")
+        got = tuple(meta["version"])
+        want = tuple(version)
+        if got == want:
+            return True
+        if got < want:
+            return False              # never landed: safe to re-execute
+        raise StoreError(
+            "EIO", f"{oid}: dup retry at {want} superseded by {got}; "
+            f"outcome unknowable")
+
     async def _current_state(self, oid: str) -> tuple[int, tuple]:
         """(logical size, version) of the object, 0/(0,0) if absent."""
         loc = self._verified_local_extent(oid, 0, 0)
@@ -739,9 +768,11 @@ class ECBackend(PGBackend):
         version = self.pg.next_version()
         entry = LogEntry(version=version, op="modify", oid=oid,
                          prior_version=self.pg._prior(oid))
-        await self.execute_write(oid, "write_full", data, entry)
+        # log-intent-first, like every write (allocation + append in
+        # one slice keeps the log monotonic)
         self.pg.log.append(entry)
         self.pg.persist_meta()
+        await self.execute_write(oid, "write_full", data, entry)
 
     async def _reconstruct(self, oid: str, idx: int,
                            exclude: frozenset) -> tuple[bytes, dict] | None:

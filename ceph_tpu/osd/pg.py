@@ -817,12 +817,19 @@ class PGInstance:
                 val = self.host.store.getattr(
                     self.backend.coll(), self.backend.ghobject(oid),
                     "u:" + op["name"])
-            except StoreError:
-                if self.pool.type == "erasure":
-                    # the primary's own chunk may be missing/degraded:
-                    # any live shard carries the replicated user attrs
-                    uattrs = await self._ec_gather_uattrs(oid)
-                    if uattrs is not None and op["name"] in uattrs:
+            except StoreError as e:
+                # only a MISSING LOCAL CHUNK falls back to the shard
+                # gather: an ENODATA from a healthy chunk is already
+                # authoritative (attrs replicate to every shard) and
+                # must not cost a cluster round trip per negative probe
+                if self.pool.type == "erasure" and e.code == "ENOENT":
+                    try:
+                        uattrs = await self._ec_gather_uattrs(oid)
+                    except StoreError as ge:
+                        if ge.code == "ENOENT":
+                            return -2, {"error": str(ge)}, b""
+                        return -5, {"error": f"EIO: {ge}"}, b""
+                    if op["name"] in uattrs:
                         return 0, {}, uattrs[op["name"]].encode("latin1")
                 return -61, {"error": f"ENODATA: xattr {op['name']!r}"}, b""
             return 0, {}, val
@@ -834,10 +841,14 @@ class PGInstance:
                           for k, v in attrs.items()
                           if k.startswith("u:")}
             except StoreError as e:
-                if self.pool.type == "erasure":
-                    uattrs = await self._ec_gather_uattrs(oid)
-                    if uattrs is not None:
-                        return 0, {"xattrs": uattrs}, b""
+                if self.pool.type == "erasure" and e.code == "ENOENT":
+                    try:
+                        return 0, {"xattrs":
+                                   await self._ec_gather_uattrs(oid)}, b""
+                    except StoreError as ge:
+                        if ge.code == "ENOENT":
+                            return -2, {"error": str(ge)}, b""
+                        return -5, {"error": f"EIO: {ge}"}, b""
                 return self._store_rc(e), {"error": str(e)}, b""
             return 0, {"xattrs": xattrs}, b""
         if kind == "omap_get":
@@ -1026,14 +1037,13 @@ class PGInstance:
             return e.rc, {"error": str(e)}, b""
         return 0, last, out or b""
 
-    async def _ec_gather_uattrs(self, oid: str) -> dict | None:
+    async def _ec_gather_uattrs(self, oid: str) -> dict:
         """User xattrs from any live shard (the degraded-primary path:
-        the local chunk is gone but >= k shards still exist)."""
-        try:
-            _, _, meta = await self.backend._gather_chunks(
-                oid, chunk_off=0, chunk_len=0)
-        except StoreError:
-            return None
+        the local chunk is gone but >= k shards still exist). Raises
+        StoreError on gather failure — a transient EIO must surface as
+        EIO, never masquerade as "attr does not exist"."""
+        _, _, meta = await self.backend._gather_chunks(
+            oid, chunk_off=0, chunk_len=0)
         return meta.get("uattrs", {})
 
     def _do_snap_read(self, kind: str, oid: str, op: dict,
@@ -1066,12 +1076,16 @@ class PGInstance:
         reqid = tuple(op["reqid"]) if op.get("reqid") else None
         if reqid is not None:
             done_ver = self.log.lookup_reqid(reqid)
-            if done_ver is not None:
+            if done_ver is not None and \
+                    await self.backend.verify_dup_committed(oid,
+                                                            done_ver):
                 # client retry of an op that already committed (its reply
                 # was lost in a failover): answer from the log instead of
                 # re-executing — appends would double-apply, deletes
                 # would answer ENOENT for a success (PrimaryLogPG dup-op
-                # check via the pg log's reqid index)
+                # check via the pg log's reqid index). An unverifiable
+                # EC dup (entry logged, shards never applied) falls
+                # through and re-executes at a fresh version.
                 return 0, {"version": list(done_ver), "dup": True}, b""
         deadline = asyncio.get_running_loop().time() + 30.0
         while True:
@@ -1150,14 +1164,17 @@ class PGInstance:
                          oid=oid, prior_version=self._prior(oid),
                          reqid=tuple(op["reqid"]) if op.get("reqid")
                          else None)
+        # LOG INTENT FIRST, atomically with version allocation (no
+        # await in between, so appends stay monotonic): a retry of an
+        # op that failed anywhere past this point hits the dup index
+        # instead of re-executing against partially-applied state. The
+        # EC backend verifies a dup hit is actually readable before
+        # answering it (see verify_dup_committed) since its entry can
+        # be logged while no shard applied.
+        self.log.append(entry)
+        self.persist_meta()
         await self.backend.execute_write(oid, kind, data, entry,
                                          off=op.get("off", 0))
-        # the replicated backend logs the entry atomically with its
-        # local apply (pre-ack, see backend.execute_write); appending
-        # here covers backends that do not
-        if entry.version > self.log.head:
-            self.log.append(entry)
-            self.persist_meta()
         return 0, {"version": list(version)}, b""
 
     async def _make_writeable(self, oid: str, snapc: dict,
@@ -1175,10 +1192,9 @@ class PGInstance:
         entry = LogEntry(version=self.next_version(), op="modify", oid=oid,
                          prior_version=self._prior(oid),
                          reqid=(*reqid, 90) if reqid else None)
+        self.log.append(entry)
+        self.persist_meta()
         await self.backend.execute_write(oid, "clone", payload, entry)
-        if entry.version > self.log.head:
-            self.log.append(entry)
-            self.persist_meta()
 
     def _prior(self, oid: str) -> Eversion:
         for e in reversed(self.log.entries):

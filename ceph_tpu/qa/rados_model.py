@@ -59,8 +59,12 @@ class ModelRunner:
 
     async def _mutate(self, oid: str, coro, new_state) -> None:
         """Run one mutation; keep the model exact on success, fork it on
-        an unknowable outcome."""
-        old_state = bytes(self.model[oid]) if oid in self.model else None
+        an unknowable outcome. The fork UNIONS the new candidate with
+        every existing one: two consecutive failed mutations must keep
+        all three possible states — dropping the middle candidate made
+        the checker reject a cluster legitimately sitting on it (found
+        by this very checker on itself)."""
+        prior = self._acceptable(oid)
         try:
             await coro
         except ObjectNotFound:
@@ -69,9 +73,9 @@ class ModelRunner:
         except (RadosError, TimeoutError, asyncio.TimeoutError) as e:
             self.uncertain_ops += 1
             dout("qa", 3, f"model: {oid} outcome unknown ({e})")
-            self.uncertain[oid] = (old_state,
-                                   bytes(new_state)
-                                   if new_state is not None else None)
+            cand = {bytes(a) if a is not None else None for a in prior}
+            cand.add(bytes(new_state) if new_state is not None else None)
+            self.uncertain[oid] = tuple(cand)
             if new_state is None:
                 self.model.pop(oid, None)
             return
@@ -225,8 +229,8 @@ class ModelRunner:
         sizes = {len(a) for a in accept if a is not None}
         assert st["size"] in sizes, f"{oid}: size {st['size']} != {sizes}"
 
-    async def final_check(self, attempts: int = 6,
-                          delay: float = 2.0) -> None:
+    async def final_check(self, attempts: int = 12,
+                          delay: float = 3.0) -> None:
         """Quiesced cluster must equal the model exactly (modulo
         uncertain objects, which may hold either candidate). Retries:
         recovery may still be converging right after the thrasher

@@ -87,17 +87,24 @@ class RGWGateway:
             method, path = parts[0].upper(), unquote(url.path)
             query = {k: v[0] for k, v in parse_qs(
                 url.query, keep_blank_values=True).items()}
-            length = 0
+            headers_in: dict[str, str] = {}
             while True:
                 line = await asyncio.wait_for(reader.readline(), 30.0)
                 if line in (b"\r\n", b"\n", b""):
                     break
                 name, _, value = line.decode(errors="replace").partition(":")
-                if name.strip().lower() == "content-length":
-                    length = int(value.strip())
-            body = await reader.readexactly(length) if length else b""
-            code, headers, out = await self._process(method, path, body,
-                                                     query)
+                headers_in[name.strip().lower()] = value.strip()
+            try:
+                length = int(headers_in.get("content-length", 0))
+            except ValueError:
+                length = -1
+            if length < 0:
+                code, headers, out = 400, {}, b"InvalidArgument"
+                body = b""
+            else:
+                body = await reader.readexactly(length) if length else b""
+                code, headers, out = await self._process(
+                    method, path, body, query, headers_in)
         except (asyncio.TimeoutError, asyncio.IncompleteReadError,
                 OSError):
             writer.close()
@@ -121,9 +128,11 @@ class RGWGateway:
     # -- S3 semantics --------------------------------------------------------
 
     async def _process(self, method: str, path: str, body: bytes,
-                       query: dict | None = None
+                       query: dict | None = None,
+                       headers_in: dict | None = None
                        ) -> tuple[int, dict, bytes]:
         query = query or {}
+        headers_in = headers_in or {}
         parts = [p for p in path.split("/") if p]
         if not parts:
             if method == "GET":
@@ -134,7 +143,7 @@ class RGWGateway:
             if method == "PUT":
                 return await self._create_bucket(bucket)
             if method == "GET":
-                return await self._list_objects(bucket)
+                return await self._list_objects(bucket, query)
             if method == "DELETE":
                 return await self._delete_bucket(bucket)
             return 405, {}, b"MethodNotAllowed"
@@ -151,7 +160,8 @@ class RGWGateway:
         if method == "PUT":
             return await self._put_object(bucket, key, body)
         if method == "GET":
-            return await self._get_object(bucket, key)
+            return await self._get_object(bucket, key,
+                                          headers_in.get("range"))
         if method == "HEAD":
             return await self._head_object(bucket, key)
         if method == "DELETE":
@@ -191,19 +201,38 @@ class RGWGateway:
         await self.io.remove(_index_oid(bucket))
         return 204, {}, b""
 
-    async def _list_objects(self, bucket: str) -> tuple[int, dict, bytes]:
+    async def _list_objects(self, bucket: str,
+                            query: dict | None = None
+                            ) -> tuple[int, dict, bytes]:
+        """ListObjects with the prefix/delimiter folding S3 clients use
+        for directory-style browsing (RGWListBucket)."""
         if not await self._bucket_exists(bucket):
             return 404, {}, b"NoSuchBucket"
+        query = query or {}
+        prefix = query.get("prefix", "")
+        delim = query.get("delimiter", "")
         index = await self.io.omap_get(_index_oid(bucket))
         items = []
+        common: set[str] = set()
         for k in sorted(index):
+            if not k.startswith(prefix):
+                continue
+            if delim:
+                rest = k[len(prefix):]
+                if delim in rest:
+                    common.add(prefix + rest.split(delim, 1)[0] + delim)
+                    continue
             meta = json.loads(index[k])
             items.append(f"<Contents><Key>{escape(k)}</Key>"
                          f"<Size>{meta['size']}</Size>"
                          f"<ETag>&quot;{meta['etag']}&quot;</ETag>"
                          f"</Contents>")
+        prefixes = "".join(
+            f"<CommonPrefixes><Prefix>{escape(p_)}</Prefix>"
+            f"</CommonPrefixes>" for p_ in sorted(common))
         xml = (f"<ListBucketResult><Name>{escape(bucket)}</Name>"
-               f"{''.join(items)}</ListBucketResult>")
+               f"<Prefix>{escape(prefix)}</Prefix>"
+               f"{''.join(items)}{prefixes}</ListBucketResult>")
         return 200, {"Content-Type": "application/xml"}, xml.encode()
 
     async def _put_object(self, bucket: str, key: str,
@@ -219,10 +248,43 @@ class RGWGateway:
             key: json.dumps({"size": len(body), "etag": etag}).encode()})
         return 200, {"ETag": f'"{etag}"'}, b""
 
-    async def _get_object(self, bucket: str,
-                          key: str) -> tuple[int, dict, bytes]:
+    async def _get_object(self, bucket: str, key: str,
+                          range_hdr: str | None = None
+                          ) -> tuple[int, dict, bytes]:
+        """GET, honoring `Range: bytes=a-b` with a 206 + Content-Range
+        (S3 ranged GET; drives the OSD's ranged read path)."""
+        oid = _data_oid(bucket, key)
+        rng = None
+        if range_hdr and range_hdr.startswith("bytes="):
+            spec = range_hdr[len("bytes="):]
+            start_s, _, end_s = spec.partition("-")
+            if start_s.isdigit():
+                rng = (int(start_s),
+                       int(end_s) if end_s.isdigit() else None)
+            elif end_s.isdigit():
+                rng = (None, int(end_s))      # suffix: last N bytes
         try:
-            data = await self.io.read(_data_oid(bucket, key))
+            if rng is not None:
+                st = await self.io.stat(oid)
+                total = st["size"]
+                start, end = rng
+                if start is None:
+                    # bytes=-N (footer probes): the last N bytes
+                    if end == 0:
+                        return 416, {"Content-Range": f"bytes */{total}"
+                                     }, b"InvalidRange"
+                    start, end = max(0, total - end), total - 1
+                else:
+                    end = total - 1 if end is None else min(end, total - 1)
+                if start >= total or start > end:
+                    return 416, {"Content-Range": f"bytes */{total}"
+                                 }, b"InvalidRange"
+                data = await self.io.read(oid, offset=start,
+                                          length=end - start + 1)
+                return 206, {
+                    "Content-Range": f"bytes {start}-{end}/{total}",
+                    "Content-Type": "application/octet-stream"}, data
+            data = await self.io.read(oid)
         except ObjectNotFound:
             return 404, {}, b"NoSuchKey"
         from ceph_tpu.native import ec_native
@@ -362,6 +424,7 @@ class RGWGateway:
         return 204, {}, b""
 
 
-_REASON = {200: "OK", 204: "No Content", 400: "Bad Request",
-           404: "Not Found", 405: "Method Not Allowed", 409: "Conflict",
-           500: "Internal Server Error"}
+_REASON = {200: "OK", 204: "No Content", 206: "Partial Content",
+           400: "Bad Request", 404: "Not Found",
+           405: "Method Not Allowed", 409: "Conflict",
+           416: "Range Not Satisfiable", 500: "Internal Server Error"}

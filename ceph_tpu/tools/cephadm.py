@@ -242,17 +242,17 @@ class CephadmCluster:
             self._admin = None
         for d in [*self.mdss.values(), *self.mgrs.values()]:
             try:
-                await d.stop()
+                await asyncio.wait_for(d.stop(), 20)
             except Exception:
                 pass
         for osd in list(self.osds.values()):
             try:
-                await osd.stop()
+                await asyncio.wait_for(osd.stop(), 20)
             except Exception:
                 pass
         for mon in self.mons.values():
             try:
-                await mon.stop()
+                await asyncio.wait_for(mon.stop(), 20)
             except Exception:
                 pass
         self.mons.clear()

@@ -87,17 +87,17 @@ class VCluster:
     async def stop(self) -> None:
         for c in self.clients:
             try:
-                await c.shutdown()
+                await asyncio.wait_for(c.shutdown(), 20)
             except Exception:
                 pass
         for osd in list(self.osds.values()):
             try:
-                await osd.stop()
+                await asyncio.wait_for(osd.stop(), 20)
             except Exception:
                 pass
         for mon in self.mons.values():
             try:
-                await mon.stop()
+                await asyncio.wait_for(mon.stop(), 20)
             except Exception:
                 pass
 

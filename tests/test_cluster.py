@@ -104,19 +104,21 @@ class ClusterHarness:
             await asyncio.sleep(0.1)
 
     async def stop(self) -> None:
+        # each stop is BOUNDED: a daemon wedged mid-teardown (rare
+        # thrash aftermath) must not hang the whole harness forever
         for c in self.clients:
             try:
-                await c.shutdown()
+                await asyncio.wait_for(c.shutdown(), 20)
             except Exception:
                 pass
         for osd in list(self.osds.values()):
             try:
-                await osd.stop()
+                await asyncio.wait_for(osd.stop(), 20)
             except Exception:
                 pass
         for mon in self.mons.values():
             try:
-                await mon.stop()
+                await asyncio.wait_for(mon.stop(), 20)
             except Exception:
                 pass
 

@@ -21,6 +21,16 @@ def _req(addr, method, path, data=None):
         return e.code, dict(e.headers), e.read()
 
 
+def _ranged_req(addr, path, spec):
+    req = urllib.request.Request(
+        f"http://{addr[0]}:{addr[1]}{path}", headers={"Range": spec})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
 def test_s3_surface_end_to_end(tmp_path):
     async def body():
         c = ClusterHarness(tmp_path)
@@ -142,6 +152,103 @@ def test_multipart_upload(tmp_path):
                         if o.startswith(".mp.")] == []
                 assert (await asyncio.to_thread(
                     _req, addr, "GET", "/vids/tmp.bin"))[0] == 404
+            finally:
+                await gw.stop()
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_list_objects_prefix_delimiter(tmp_path):
+    """Directory-style listing: prefix filters, delimiter folds common
+    prefixes (the S3 ListObjects contract clients browse with)."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rgw3", pg_num=4, size=3)
+            gw = RGWGateway(cl.ioctx("rgw3"))
+            addr = await gw.start()
+            try:
+                await asyncio.to_thread(_req, addr, "PUT", "/tree")
+                for key in ("a/1.txt", "a/2.txt", "a/b/3.txt",
+                            "c/4.txt", "top.txt"):
+                    await asyncio.to_thread(
+                        _req, addr, "PUT", f"/tree/{key}", b"x")
+                code, _, body_ = await asyncio.to_thread(
+                    _req, addr, "GET", "/tree?delimiter=/")
+                text = body_.decode()
+                assert "top.txt" in text
+                assert "<Prefix>a/</Prefix>" in text
+                assert "<Prefix>c/</Prefix>" in text
+                assert "1.txt" not in text        # folded under a/
+                code, _, body_ = await asyncio.to_thread(
+                    _req, addr, "GET", "/tree?prefix=a/&delimiter=/")
+                text = body_.decode()
+                assert "a/1.txt" in text and "a/2.txt" in text
+                assert "<Prefix>a/b/</Prefix>" in text
+                assert "3.txt" not in text
+                code, _, body_ = await asyncio.to_thread(
+                    _req, addr, "GET", "/tree?prefix=c/")
+                assert "c/4.txt" in body_.decode()
+            finally:
+                await gw.stop()
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_ranged_get(tmp_path):
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rgw4", pg_num=4, size=3)
+            gw = RGWGateway(cl.ioctx("rgw4"))
+            addr = await gw.start()
+            try:
+                await asyncio.to_thread(_req, addr, "PUT", "/b")
+                blob = bytes(range(256)) * 40
+                await asyncio.to_thread(_req, addr, "PUT", "/b/o", blob)
+
+                code, hdrs, got = await asyncio.to_thread(
+                    _ranged_req, addr, "/b/o", "bytes=100-199")
+                assert code == 206 and got == blob[100:200]
+                assert hdrs["Content-Range"] == \
+                    f"bytes 100-199/{len(blob)}"
+                code, _, got = await asyncio.to_thread(
+                    _ranged_req, addr, "/b/o", "bytes=10200-")
+                assert code == 206 and got == blob[10200:]
+                code, _, _ = await asyncio.to_thread(
+                    _ranged_req, addr, "/b/o", f"bytes={len(blob) + 5}-")
+                assert code == 416
+            finally:
+                await gw.stop()
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_suffix_range_get(tmp_path):
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rgw5", pg_num=4, size=3)
+            gw = RGWGateway(cl.ioctx("rgw5"))
+            addr = await gw.start()
+            try:
+                await asyncio.to_thread(_req, addr, "PUT", "/b")
+                blob = bytes(range(256)) * 20
+                await asyncio.to_thread(_req, addr, "PUT", "/b/o", blob)
+                code, hdrs, got = await asyncio.to_thread(
+                    _ranged_req, addr, "/b/o", "bytes=-500")
+                assert code == 206 and got == blob[-500:]
+                assert hdrs["Content-Range"] == \
+                    f"bytes {len(blob) - 500}-{len(blob) - 1}/{len(blob)}"
             finally:
                 await gw.stop()
         finally:
