@@ -306,7 +306,17 @@ class ECBackend(PGBackend):
                                                    chunk_len=0)
         except StoreError as e:
             if e.code == "ENOENT":
-                return True
+                # no shard anywhere: EITHER a later delete committed
+                # (done) OR this very entry was a first write that
+                # never applied (must re-execute). The log's newest
+                # entry for the oid tells them apart.
+                for ent in reversed(self.pg.log.entries):
+                    if ent.oid != oid:
+                        continue
+                    if ent.op == "delete":
+                        return True     # deletion explains the absence
+                    break
+                return False            # never applied: re-execute
             raise StoreError(
                 "EIO", f"{oid}: dup retry unverifiable ({e})")
         got = tuple(meta["version"])

@@ -48,6 +48,9 @@ class ModelRunner:
         self.snaps: dict[str, dict] = {}
         self._snap_seq_names = 0
         self.snap_ops = 0
+        # xattr truth (oid -> {name: bytes}) + per-attr uncertainty
+        self.xattr_model: dict[str, dict] = {}
+        self.xattr_uncertain: dict[tuple, tuple] = {}
 
     def _oid(self) -> str:
         return f"m{self.rng.randrange(self.max_objects):03d}"
@@ -78,10 +81,19 @@ class ModelRunner:
             self.uncertain[oid] = tuple(cand)
             if new_state is None:
                 self.model.pop(oid, None)
+                # the delete may or may not have applied: every tracked
+                # attr forks to (old value, gone) — write_full preserves
+                # xattrs, so "survived the failed delete" stays a valid
+                # candidate even after later data writes
+                for name, val in self.xattr_model.pop(oid, {}).items():
+                    prior = self.xattr_uncertain.get((oid, name),
+                                                     (val,))
+                    self.xattr_uncertain[(oid, name)] = (*prior, None)
             return
         self.uncertain.pop(oid, None)
         if new_state is None:
             self.model.pop(oid, None)
+            self._drop_xattrs(oid)
         else:
             self.model[oid] = bytearray(new_state)
 
@@ -98,6 +110,9 @@ class ModelRunner:
             roll = 0.0
         if self.enable_snaps and roll >= 0.97:
             await self._snap_op()
+            return
+        if 0.94 <= roll < 0.97:
+            await self._xattr_op(oid)
             return
         if roll < 0.25:
             data = self._payload()
@@ -124,6 +139,57 @@ class ModelRunner:
             await self._check_read(oid)
         else:
             await self._check_stat(oid)
+
+    def _drop_xattrs(self, oid: str) -> None:
+        """A (possibly-)deleted head takes its xattrs with it: stop
+        tracking them (a recreate starts clean)."""
+        self.xattr_model.pop(oid, None)
+        for key in [k for k in self.xattr_uncertain if k[0] == oid]:
+            del self.xattr_uncertain[key]
+
+    # -- xattrs (both pool types: EC replicates them per shard) -----------
+
+    async def _xattr_op(self, oid: str) -> None:
+        """setxattr/getxattr verification riding its own uncertainty
+        bookkeeping. Only runs against objects the DATA model holds
+        with certainty: setxattr would otherwise create objects behind
+        the data model's back, and a deleted object's xattrs die with
+        its head (see _mutate's cleanup)."""
+        if oid not in self.model or oid in self.uncertain:
+            return
+        name = f"k{self.rng.randrange(3)}"
+        roll = self.rng.random()
+        cur = self.xattr_model.get(oid, {})
+        if roll < 0.55:
+            val = self.rng.randbytes(self.rng.randrange(1, 64))
+            try:
+                await self.io.setxattr(oid, name, val)
+            except (RadosError, TimeoutError, asyncio.TimeoutError):
+                old = cur.get(name)
+                prior = self.xattr_uncertain.get((oid, name), (old,))
+                self.xattr_uncertain[(oid, name)] = (*prior, val)
+                return
+            self.xattr_uncertain.pop((oid, name), None)
+            self.xattr_model.setdefault(oid, {})[name] = val
+            return
+        # verify
+        accept = self.xattr_uncertain.get((oid, name),
+                                          (cur.get(name),))
+        try:
+            got = await self.io.getxattr(oid, name)
+        except ObjectNotFound:
+            return          # object raced a delete: data model handles
+        except (RadosError, TimeoutError, asyncio.TimeoutError) as e:
+            if getattr(e, "rc", 0) == -61:
+                # ENODATA is authoritative: only fine if "absent" is
+                # an acceptable state for this attr
+                assert any(a is None for a in accept), \
+                    f"{oid} xattr {name}: ENODATA but model has " \
+                    f"{[a for a in accept]}"
+            return          # transiently unreadable mid-thrash
+        assert any(a is not None and bytes(a) == got for a in accept), \
+            f"{oid} xattr {name}: {got!r} not in " \
+            f"{[a for a in accept]}"
 
     # -- snapshots --------------------------------------------------------
 

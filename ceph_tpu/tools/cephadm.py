@@ -238,7 +238,10 @@ class CephadmCluster:
 
     async def stop(self) -> None:
         if self._admin is not None:
-            await self._admin.shutdown()
+            try:
+                await asyncio.wait_for(self._admin.shutdown(), 20)
+            except Exception:
+                pass
             self._admin = None
         for d in [*self.mdss.values(), *self.mgrs.values()]:
             try:
