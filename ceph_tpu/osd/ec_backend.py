@@ -310,13 +310,7 @@ class ECBackend(PGBackend):
                 # (done) OR this very entry was a first write that
                 # never applied (must re-execute). The log's newest
                 # entry for the oid tells them apart.
-                for ent in reversed(self.pg.log.entries):
-                    if ent.oid != oid:
-                        continue
-                    if ent.op == "delete":
-                        return True     # deletion explains the absence
-                    break
-                return False            # never applied: re-execute
+                return self._log_tombstoned(oid)
             raise StoreError(
                 "EIO", f"{oid}: dup retry unverifiable ({e})")
         got = tuple(meta["version"])
@@ -813,6 +807,21 @@ class ECBackend(PGBackend):
             attrs["u:" + name] = val.encode("latin1")
         return chunk, attrs
 
+    def _log_tombstoned(self, oid: str) -> bool:
+        """True when the authoritative log's newest word on `oid` is a
+        delete: recovery must then push the DELETION, never a
+        reconstruction — the surviving shards' rollback generations
+        (stashed by _stash_prev before every apply, the delete included)
+        could otherwise reassemble the pre-delete object and resurrect
+        it onto the recovering peer as a lone undecodable shard, turning
+        every later read into a permanent EIO (found by the thrashing
+        model checker; the reference's recovery honors delete log
+        entries the same way, PGLog missing `is_delete`)."""
+        for ent in reversed(self.pg.log.entries):
+            if ent.oid == oid:
+                return ent.op == "delete"
+        return False
+
     async def push_object(self, peer: int, oid: str) -> None:
         """Reconstruct `peer`'s positional chunk from k survivors and
         push it (the reference recovery reads min-to-decode and
@@ -820,6 +829,9 @@ class ECBackend(PGBackend):
         try:
             idx = self.pg.acting.index(peer)
         except ValueError:
+            return
+        if self._log_tombstoned(oid):
+            await self.pg.send_push(peer, oid, b"", None, delete=True)
             return
         try:
             # the target is NOT excluded from the gather: version attrs
@@ -846,6 +858,11 @@ class ECBackend(PGBackend):
         chunk is a different position; the gather already consults every
         live shard, so `fallbacks` is implicit here)."""
         me = self.pg.acting.index(self.host.whoami)
+        if self._log_tombstoned(oid):
+            # authoritative history deleted it (belt-and-braces: the
+            # caller's ZERO-need tombstone normally catches this)
+            self.local_apply(oid, "delete", b"")
+            return
         try:
             rec = await self._reconstruct(oid, me, exclude=frozenset())
         except StoreError as e:

@@ -306,6 +306,65 @@ def test_ec_recovery_reconstructs_lost_shards(tmp_path):
     run(body())
 
 
+def test_ec_delete_while_osd_down_is_not_resurrected(tmp_path):
+    """A delete committed while one shard-holder is down must stay a
+    delete after the holder revives: recovery pushes the DELETION to the
+    behind peer. Reconstructing from the surviving shards' rollback
+    generations instead resurrects a lone stale shard — every later read
+    then EIOs forever (1 < k shards yet not ENOENT). Found by the
+    thrashing model checker (ref: recovery honoring delete log
+    entries, src/osd/PGLog.h missing is_delete)."""
+    async def body():
+        from ceph_tpu.rados import ObjectNotFound
+        c = ClusterHarness(tmp_path, n_osds=4)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.command({"prefix": "osd erasure-code-profile set",
+                              "name": "jprof",
+                              "profile": {"plugin": "jerasure", "k": "2",
+                                          "m": "2",
+                                          "technique": "reed_sol_van"}})
+            await cl.pool_create("ecpool", pg_num=4, pool_type="erasure",
+                                 erasure_code_profile="jprof")
+            io = cl.ioctx("ecpool")
+            for i in range(6):
+                await io.write_full(f"o{i}", bytes([i + 1]) * 5000)
+            victim = c.osds[3]
+            store = victim.store
+            await c.kill_osd(3)
+            await c.wait_osd_down(3)
+            for i in range(6):          # deletes commit on 3 live shards
+                await io.remove(f"o{i}")
+            await c.start_osd(3, store=store)
+            # convergence: the revived osd must drop its stale shards,
+            # and reads must settle on ENOENT — never a wedged EIO
+            deadline = asyncio.get_running_loop().time() + 25
+            while True:
+                osd3 = c.osds[3]
+                stale = [oid for pg in osd3.pgs.values()
+                         if osd3.whoami in pg.acting
+                         for oid in pg.list_objects()
+                         if oid.startswith("o")]
+                if not stale:
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError(
+                        f"revived osd still holds deleted objects' "
+                        f"shards: {stale[:6]}")
+                await asyncio.sleep(0.2)
+            for i in range(6):
+                try:
+                    await io.read(f"o{i}")
+                    raise AssertionError(f"o{i}: read succeeded after "
+                                         f"committed delete")
+                except ObjectNotFound:
+                    pass
+        finally:
+            await c.stop()
+    run(body())
+
+
 @pytest.mark.parametrize("backend", ["memstore", "filestore"])
 def test_osd_restart_recovers_by_log(tmp_path, backend):
     """Kill an osd, write while it is down, restart it with the same
