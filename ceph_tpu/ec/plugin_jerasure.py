@@ -104,6 +104,51 @@ class ErasureCodeJerasure(ErasureCode):
         for row, i in enumerate(want):
             chunks[i][:] = rec[row]
 
+    # -- batched stripe API (the ec_util one-dispatch driver) ---------------
+
+    def _apply_flat(self, M: np.ndarray, src) -> np.ndarray:
+        """(S, rows_in, C) through M (rows_out, rows_in) -> (S, rows_out, C).
+
+        Host arrays: the stripe axis folds into the byte lanes so the
+        whole batch is ONE matrix application — via the native
+        split-table SIMD codec when available (the OSD write path feeds
+        host bytes; per-stripe dispatch was ~100x slower there), else
+        one MatrixCodec dispatch (the reference amortizes the same way
+        at its ECUtil::encode batching site, src/osd/ECUtil.cc:134).
+        Device arrays stay on device (device in => device out, the
+        plugin_tpu contract) — silently pulling a jax batch to host
+        would hide a ~5 MB/s tunnel transfer inside a "device" bench.
+        Host output is stripe-major as a VIEW over shard-major storage:
+        the ec_util consumers re-transpose to shard-major, so their
+        ascontiguousarray lands back on this buffer for free."""
+        import jax
+        if isinstance(src, jax.Array):
+            return rs_codec.MatrixCodec.get(M).apply_batch_device(src)
+        from ceph_tpu.native import ec_native
+        src = np.ascontiguousarray(src, dtype=np.uint8)
+        S, kin, C = src.shape
+        rows = M.shape[0]
+        flat = np.ascontiguousarray(src.transpose(1, 0, 2)).reshape(
+            kin, S * C)
+        if ec_native.available():
+            out = np.empty((rows, S * C), dtype=np.uint8)
+            ec_native.encode(M, flat, out)
+        else:
+            out = rs_codec.MatrixCodec.get(M).apply(flat)
+        return out.reshape(rows, S, C).transpose(1, 0, 2)
+
+    def encode_stripes(self, data):
+        """(S, k, C) data stripes -> (S, m, C) parity, one dispatch."""
+        return self._apply_flat(self.coding_matrix, data)
+
+    def decode_stripes(self, avail_ids: tuple[int, ...],
+                       want_ids: tuple[int, ...], chunks) -> np.ndarray:
+        """Batched reconstruction of `want_ids` from the first-k available
+        chunks stacked in `avail_ids` order: (S, k, C) -> (S, want, C)."""
+        R = rs_codec.recovery_matrix(self.coding_matrix, tuple(avail_ids),
+                                     tuple(want_ids))
+        return self._apply_flat(R, chunks)
+
 
 class ErasureCodeJerasureReedSolomonVandermonde(ErasureCodeJerasure):
     technique = "reed_sol_van"
@@ -142,6 +187,11 @@ class ErasureCodeJerasureBitMatrix(ErasureCodeJerasure):
     (liberation/blaum_roth/liber8tion): m=2, word size w, chunk = w
     contiguous packets. Lowers onto ceph_tpu.ec.bitmatrix rather than
     the GF(2^8) codec (these codes are not GF(2^8) matrices)."""
+
+    # the GF(2^8) batched stripe API does not apply to GF(2) bit codes;
+    # ec_util's callable() gate sends these through the per-stripe loop
+    encode_stripes = None
+    decode_stripes = None
 
     def _check_w(self) -> None:
         pass            # per-technique constraints in _check_technique

@@ -99,8 +99,15 @@ class ECBackend(PGBackend):
         return data + b"\x00" * ((-len(data)) % w)
 
     def _csums(self, shard_buf: bytes) -> list[int]:
-        """Per-chunk crc32c list of a shard buffer (Checksummer analog)."""
+        """Per-chunk crc32c list of a shard buffer (Checksummer analog).
+        One native batch call per buffer: a per-chunk Python/ctypes loop
+        was ~25us per chunk and dominated the write path (profiled)."""
         c = self.sinfo.chunk_size
+        if shard_buf and len(shard_buf) % c == 0:
+            from ceph_tpu.native import ec_native
+            import numpy as np
+            return [int(x) for x in ec_native.crc32c_blocks(
+                np.frombuffer(shard_buf, dtype=np.uint8), c)]
         return [self._crc32c(shard_buf[i:i + c])
                 for i in range(0, len(shard_buf), c)]
 
@@ -134,9 +141,9 @@ class ECBackend(PGBackend):
         shard = int(attrs["shard"])
         csums = json.loads(attrs.get("csum", b"[]"))
         c = self.sinfo.chunk_size
-        for i in range(0, len(data), c):
-            s = (chunk_off + i) // c
-            have = self._crc32c(data[i:i + c])
+        haves = self._csums(data) if data else []
+        for i, have in enumerate(haves):
+            s = chunk_off // c + i
             want = csums[s] if s < len(csums) else None
             if have != want:
                 dout("osd", 1, f"ec shard {shard} of {oid}: chunk {s} crc "
