@@ -134,8 +134,19 @@ class PGInstance:
         return Ghobject(pool=self.pgid.pool, name=PGMETA_OID)
 
     def persist_meta(self) -> None:
-        blob = json.dumps({"log": self.log.to_dict(), "seq": self.seq,
+        """Durable PG meta: a small static attr (head/tail/missing/seq)
+        plus ONE omap key per log entry, written incrementally — only
+        entries that changed since the last persist are (re)written.
+        Re-serializing the whole 1000-entry window per op dominated the
+        write path (profiled); the reference stores log entries as
+        individual omap keys for the same reason
+        (src/osd/PGLog.cc _write_log_and_missing)."""
+        blob = json.dumps({"seq": self.seq,
                            "les": self.last_epoch_started,
+                           "head": list(self.log.head),
+                           "tail": list(self.log.tail),
+                           "missing": {o: list(v) for o, v in
+                                       self.log.missing.items()},
                            "purged_snaps": sorted(self.purged_snaps)}
                           ).encode()
         cid = self.backend.coll()
@@ -144,16 +155,50 @@ class PGInstance:
         if not self.host.store.exists(cid, gh):
             txn.touch(cid, gh)
         txn.setattr(cid, gh, "pgmeta", blob)
-        self.host.store.queue_transaction(txn)
+        full, dirty = self.log.take_dirty()
+        if full:
+            # the meta omap is shared (SnapMapper keys live there too):
+            # remove only the log-prefixed keys, never omap_clear
+            try:
+                stale = [k for k in self.host.store.omap_get(cid, gh)
+                         if k.startswith(PGLog.KEY_PREFIX)]
+            except StoreError:
+                stale = []
+            if stale:
+                txn.omap_rmkeys(cid, gh, stale)
+            txn.omap_setkeys(cid, gh, {
+                PGLog.entry_key(e.version):
+                    json.dumps(e.to_dict()).encode()
+                for e in self.log.entries})
+        else:
+            rm = [k for k, v in dirty.items() if v is None]
+            if rm:
+                txn.omap_rmkeys(cid, gh, rm)
+            sets = {k: json.dumps(v.to_dict()).encode()
+                    for k, v in dirty.items() if v is not None}
+            if sets:
+                txn.omap_setkeys(cid, gh, sets)
+        try:
+            self.host.store.queue_transaction(txn)
+        except Exception:
+            # the delta never reached disk: hand it back or those
+            # entries vanish from the persisted omap forever
+            self.log.restore_dirty(full, dirty)
+            raise
 
     def _load_meta(self) -> None:
         cid = self.backend.coll()
+        gh = self._meta_gh()
         try:
-            blob = self.host.store.getattr(cid, self._meta_gh(), "pgmeta")
+            blob = self.host.store.getattr(cid, gh, "pgmeta")
         except StoreError:
             return
         meta = json.loads(blob)
-        self.log = PGLog.from_dict(meta["log"])
+        if "log" in meta:           # legacy inline-entries format
+            self.log = PGLog.from_dict(meta["log"])
+        else:
+            self.log = PGLog.from_omap(
+                meta, self.host.store.omap_get(cid, gh))
         self.seq = meta.get("seq", self.log.head[1])
         self.last_epoch_started = meta.get("les", 0)
         self.purged_snaps = set(meta.get("purged_snaps", []))

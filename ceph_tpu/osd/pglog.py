@@ -17,6 +17,7 @@ tail.
 from __future__ import annotations
 
 import dataclasses
+import json
 from typing import Iterable
 
 Eversion = tuple[int, int]      # (epoch, seq) — totally ordered
@@ -67,12 +68,68 @@ class PGLog:
         # dup-op index: reqid -> version of the entry that executed it
         # (PGLog dups; horizon = the retained entry window)
         self._reqids: dict[tuple, Eversion] = {}
+        # incremental-persistence dirty state: persist_meta writes ONE
+        # omap key per changed entry instead of re-serializing the whole
+        # window per op (the reference stores pg log entries as
+        # individual omap keys the same way, src/osd/PGLog.cc
+        # _write_log_and_missing). A fresh/replaced log starts
+        # dirty_full so wholesale adoption rewrites everything.
+        self._dirty_full = True
+        self._dirty: dict[str, LogEntry | None] = {}    # key -> entry|del
+
+    # omap key namespace: the pgmeta object's omap is shared with the
+    # SnapMapper ("sm_..." keys) — log keys carry their own prefix
+    KEY_PREFIX = "log."
+
+    @classmethod
+    def entry_key(cls, v: Eversion) -> str:
+        """Lexically-sortable omap key of one entry."""
+        return f"{cls.KEY_PREFIX}{v[0]:012d}.{v[1]:012d}"
+
+    def take_dirty(self) -> tuple[bool, dict[str, "LogEntry | None"]]:
+        """Consume the pending persistence delta: (full_rewrite, {key ->
+        entry or None=deleted}). The caller must durably apply it (or a
+        full rewrite) in the same transaction as the static meta — and
+        hand it back via restore_dirty() if that transaction fails, or
+        the entries silently vanish from the persisted omap forever."""
+        full, dirty = self._dirty_full, self._dirty
+        self._dirty_full, self._dirty = False, {}
+        return full, dirty
+
+    def restore_dirty(self, full: bool,
+                      dirty: dict[str, "LogEntry | None"]) -> None:
+        """Re-merge a delta whose transaction failed; dirt recorded
+        since the failed take wins on key collisions."""
+        self._dirty_full = self._dirty_full or full
+        merged = dict(dirty)
+        merged.update(self._dirty)
+        self._dirty = merged
+
+    @classmethod
+    def from_omap(cls, meta: dict, omap: dict[str, bytes]) -> "PGLog":
+        """Rebuild from the persisted form written by the incremental
+        path: static fields from the pgmeta blob, entries from the
+        log-prefixed omap keys (lexicographic key order IS version
+        order). The loaded instance starts clean — disk already
+        matches."""
+        log = cls()
+        log.entries = [LogEntry.from_dict(json.loads(v))
+                       for k, v in sorted(omap.items())
+                       if k.startswith(cls.KEY_PREFIX)]
+        log.head = tuple(meta.get("head", [0, 0]))
+        log.tail = tuple(meta.get("tail", [0, 0]))
+        log.missing = {o: tuple(v)
+                       for o, v in meta.get("missing", {}).items()}
+        log._rebuild_reqids()
+        log._dirty_full = False
+        return log
 
     # -- append path ---------------------------------------------------------
 
     def append(self, entry: LogEntry) -> None:
         assert entry.version > self.head, (entry, self.head)
         self.entries.append(entry)
+        self._dirty[self.entry_key(entry.version)] = entry
         self.head = entry.version
         if entry.reqid is not None:
             self._reqids[entry.reqid] = entry.version
@@ -82,6 +139,7 @@ class PGLog:
             for e in self.entries[:drop]:
                 if e.reqid is not None:
                     self._reqids.pop(e.reqid, None)
+                self._dirty[self.entry_key(e.version)] = None
             del self.entries[:drop]
 
     def lookup_reqid(self, reqid: tuple) -> Eversion | None:
@@ -105,6 +163,7 @@ class PGLog:
                     and e.reqid is not None:
                 self._reqids.pop(e.reqid, None)
                 e.reqid = None
+                self._dirty[self.entry_key(e.version)] = e
 
     # -- peering -------------------------------------------------------------
 
@@ -135,6 +194,8 @@ class PGLog:
                             if e.version <= auth_head]
             self.head = self.entries[-1].version if self.entries \
                 else self.tail
+            # a rewind invalidates persisted suffix keys: rewrite whole
+            self._dirty_full = True
         for e in divergent:
             # latest authoritative version of that object, if any
             auth_v = ZERO
