@@ -219,6 +219,30 @@ class ECBackend(PGBackend):
         elif op == "rmxattr":
             payloads = {i: ({"op": "rmxattr",
                              "name": data.decode()}, b"") for i in live}
+        elif op == "zero":
+            # same store semantics as the replicated txn.zero here: a
+            # ranged write of zeros (extends past the end like a write)
+            payloads = await self._plan_rmw(oid, "write",
+                                            off, b"\x00" * int(data),
+                                            entry, live)
+            if payloads is None:
+                return
+        elif op == "truncate":
+            cur_size, _ver = await self._current_state(oid)
+            if off == cur_size:
+                return
+            if off > cur_size:
+                # GROW rides the zero-fill RMW: the old tail stripe may
+                # carry residue past cur_size (a prior mid-stripe
+                # shrink keeps the stripe's bytes), and growing the
+                # logical size would expose it as data — the RMW plan
+                # re-encodes that stripe with explicit zeros (found by
+                # the thrashing model checker)
+                payloads = await self._plan_rmw(
+                    oid, "write", cur_size, b"\x00" * (off - cur_size),
+                    entry, live, cur_state=(cur_size, _ver))
+            else:
+                payloads = self._plan_shrink(off, entry, live)
         elif op in ("write", "append"):
             payloads = await self._plan_rmw(oid, op, off, data, entry, live)
             if payloads is None:        # zero-length no-op past the plan
@@ -232,14 +256,18 @@ class ECBackend(PGBackend):
         return {k: v.decode("latin1") for k, v in attrs.items()}
 
     async def _plan_rmw(self, oid: str, op: str, off: int, data: bytes,
-                        entry: LogEntry, live: dict) -> dict | None:
+                        entry: LogEntry, live: dict,
+                        cur_state: tuple | None = None) -> dict | None:
         """get_write_plan + generate_transactions analog
         (src/osd/ECTransaction.h:34, :97): stripe-align the touched
         range, read back only the stripe fragments the new data does not
         fully cover, re-encode the touched stripes in one batched
-        dispatch, and emit per-shard extent sub-writes."""
+        dispatch, and emit per-shard extent sub-writes. `cur_state`
+        passes an already-gathered (size, version) to avoid a second
+        gather under the same object lock."""
         w, c = self.sinfo.stripe_width, self.sinfo.chunk_size
-        cur_size, cur_ver = await self._current_state(oid)
+        cur_size, cur_ver = cur_state if cur_state is not None \
+            else await self._current_state(oid)
         if op == "append":
             off = cur_size
         if not data:
@@ -247,6 +275,15 @@ class ECBackend(PGBackend):
         new_size = max(cur_size, off + len(data))
         first = off // w
         last = -(-(off + len(data)) // w)   # exclusive
+        if new_size > cur_size and cur_size % w and cur_size // w < first:
+            # growing past a mid-stripe tail: that tail stripe must be
+            # rewritten too, or its residue past cur_size (left by a
+            # shrink) surfaces as logical data once the size grows over
+            # it (found by the thrashing model checker). The in-between
+            # hole stripes get dense explicit zeros — O(gap) work,
+            # acceptable at this stripe scale (a sparse two-extent plan
+            # is the optimization if huge seeks ever matter).
+            first = cur_size // w
         old_n = -(-cur_size // w)
         read_upto = min(last, old_n)
         need_read = any(
@@ -260,6 +297,15 @@ class ECBackend(PGBackend):
             existing = ec_util.decode_concat(self.sinfo, self.ec_impl, got)
         region = bytearray((last - first) * w)
         region[:len(existing)] = existing
+        if existing:
+            # bytes past the CURRENT logical size are stale tail-stripe
+            # residue (a mid-stripe truncate keeps the stripe's
+            # data+parity consistent but logically cut): they must read
+            # back as zeros or a gap-leaving write resurrects them into
+            # the zero-filled gap (found by the thrashing model checker)
+            base_tail = cur_size - first * w
+            if 0 <= base_tail < len(region):
+                region[base_tail:] = b"\x00" * (len(region) - base_tail)
         start = off - first * w
         region[start:start + len(data)] = data
         # bytes past new_size inside the tail stripe are padding: zero
@@ -285,6 +331,24 @@ class ECBackend(PGBackend):
                             "shard": i,
                             "version": list(entry.version)}, shards[i])
         return payloads
+
+    def _plan_shrink(self, size: int, entry: LogEntry,
+                     live: dict) -> dict:
+        """Per-shard shrink plan: an extent_write with no data — the
+        shared apply path truncates the blob to the new chunk count and
+        trims/refreshes the csum list (the reference's EC truncate rides
+        generate_transactions the same way, src/osd/ECTransaction.cc).
+        No re-encode is needed: whole tail stripes drop, and the
+        partially-cut tail stripe keeps consistent data+parity — reads
+        slice to ec_size, and every RMW re-zeroes past it before reuse
+        (see _plan_rmw's residue handling)."""
+        w = self.sinfo.stripe_width
+        new_chunks = -(-size // w)
+        return {i: ({"op": "extent_write", "chunk_off": 0,
+                     "new_size": size, "new_chunks": new_chunks,
+                     "csum_updates": [], "shard": i,
+                     "version": list(entry.version)}, b"")
+                for i in live}
 
     def _local_user_attrs(self, oid: str) -> dict[str, bytes]:
         """This OSD's copy of the object's user xattrs (replicated onto

@@ -63,6 +63,10 @@ class PGInstance:
         self.seq = 0                    # per-PG op sequence (eversion minor)
         self._active_event = asyncio.Event()
         self._peer_task: asyncio.Task | None = None
+        # acting member -> boot addr at the current interval (up_from
+        # analog: a changed addr with an unchanged acting set means a
+        # peer restarted and the interval must roll)
+        self._interval_addrs: dict[int, str] = {}
         # peering scratch: peer osd -> {"info":..., "entries":...}
         self._peer_logs: dict[int, dict] = {}
         self._peer_waiters: dict[int, asyncio.Future] = {}
@@ -234,10 +238,21 @@ class PGInstance:
     # -- map advance ---------------------------------------------------------
 
     def advance_map(self, up: list[int], acting: list[int]) -> None:
-        """New osdmap epoch: if the acting set changed, re-peer
-        (the reference starts a new peering interval, PeeringState
-        advance_map/start_peering_interval)."""
-        if acting == self.acting:
+        """New osdmap epoch: if the acting set changed — or any acting
+        member RESTARTED without ever being marked down (same set, new
+        boot address) — re-peer (the reference starts a new peering
+        interval, PeeringState advance_map/start_peering_interval; a
+        restart inside the heartbeat grace changes up_from and is a new
+        interval per check_new_interval, which PastIntervals records —
+        here the boot address plays the up_from role). Without this, a
+        sub-op lost in a kill+revive-within-grace window is never
+        repaired: no epoch changes the acting set, so no peering runs
+        and the revived peer serves its stale shard forever (found by
+        the thrashing model checker)."""
+        addrs = {o: self.host.osdmap.get_addr(o) for o in acting
+                 if o != CRUSH_NONE and o in self.host.osdmap.osds}
+        restarted = addrs != self._interval_addrs
+        if acting == self.acting and not restarted:
             if self.state in ("active", "replica"):
                 return
             if (self.state == "peering" and self._peer_task is not None
@@ -245,7 +260,8 @@ class PGInstance:
                 # same interval, peering already in flight: a second task
                 # would clobber the first's _peer_waiters (ADVICE r4)
                 return
-        interval_changed = acting != self.acting
+        self._interval_addrs = addrs
+        interval_changed = acting != self.acting or restarted
         self.up, self.acting = list(up), list(acting)
         if interval_changed:
             self.backend.fail_inflight("peering interval change")
@@ -796,13 +812,11 @@ class PGInstance:
                          "zero", "create", "delete", "setxattr", "rmxattr",
                          "omap_set", "omap_rm", "rollback", "snaptrim"})
     # the reference rejects omap on EC pools (PrimaryLogPG.cc
-    # pool.info.supports_omap()); truncate/zero need shrink machinery
-    # our EC stripe driver does not carry yet (divergence: the
-    # reference allows truncate on EC; snapshots require replicated
-    # pools here, like pre-overwrite EC in the reference). User xattrs
-    # replicate onto every shard, like the reference.
-    EC_UNSUPPORTED = frozenset({"truncate", "zero",
-                                "omap_set", "omap_rm", "omap_get",
+    # pool.info.supports_omap()); snapshots require replicated pools
+    # here, like pre-overwrite EC in the reference. truncate/zero ride
+    # the EC write plan (per-shard truncate sub-ops / zero-fill RMW).
+    # User xattrs replicate onto every shard, like the reference.
+    EC_UNSUPPORTED = frozenset({"omap_set", "omap_rm", "omap_get",
                                 "omap_vals",
                                 "rollback", "snaptrim", "list_snaps"})
 

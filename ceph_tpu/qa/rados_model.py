@@ -122,7 +122,7 @@ class ModelRunner:
             new = bytearray(cur or b"")
             new += data
             await self._mutate(oid, self.io.append(oid, data), new)
-        elif roll < 0.65:
+        elif roll < 0.55:
             data = self._payload()
             off = self.rng.randrange(0, len(cur) + self.w if cur else
                                      2 * self.w)
@@ -132,6 +132,26 @@ class ModelRunner:
             new[off:off + len(data)] = data
             await self._mutate(oid, self.io.write(oid, data, offset=off),
                                new)
+        elif roll < 0.60:
+            # truncate: shrink or zero-extend (both pool types)
+            size = self.rng.randrange(0, (len(cur) if cur else self.w)
+                                      + self.w)
+            new = bytearray(cur or b"")
+            if size <= len(new):
+                del new[size:]
+            else:
+                new += b"\0" * (size - len(new))
+            await self._mutate(oid, self.io.truncate(oid, size), new)
+        elif roll < 0.65:
+            # zero an extent (writes zeros; extends like a write)
+            off = self.rng.randrange(0, len(cur) + self.w if cur else
+                                     2 * self.w)
+            ln = self.rng.randrange(1, 2 * self.w)
+            new = bytearray(cur or b"")
+            if off + ln > len(new):
+                new += b"\0" * (off + ln - len(new))
+            new[off:off + ln] = b"\0" * ln
+            await self._mutate(oid, self.io.zero(oid, off, ln), new)
         elif roll < 0.75:
             if oid in self.model or oid in self.uncertain:
                 await self._mutate(oid, self.io.remove(oid), None)

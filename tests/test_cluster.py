@@ -306,6 +306,50 @@ def test_ec_recovery_reconstructs_lost_shards(tmp_path):
     run(body())
 
 
+def test_restart_within_grace_rolls_the_interval(tmp_path):
+    """An OSD killed and revived before the mon ever marks it down gets
+    a new boot address but the SAME acting sets: peering must still
+    re-run (the reference's check_new_interval treats a changed up_from
+    as a new interval via PastIntervals), or sub-ops lost in the
+    restart window are never repaired."""
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=3)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=8, size=3)
+            io = cl.ioctx("rbd")
+            for i in range(8):
+                await io.write_full(f"o{i}", b"x" * 2000)
+            before = {pg.pgid: pg.last_epoch_started
+                      for o in c.osds.values() if o.whoami != 2
+                      for pg in o.pgs.values()
+                      if pg.is_primary() and 2 in pg.acting}
+            assert before, "no primary has osd.2 in acting"
+            store = c.osds[2].store
+            await c.kill_osd(2)
+            await c.start_osd(2, store=store)   # well inside HB_GRACE
+            deadline = asyncio.get_running_loop().time() + 15
+            while True:
+                after = {pg.pgid: pg.last_epoch_started
+                         for o in c.osds.values() if o.whoami != 2
+                         for pg in o.pgs.values()
+                         if pg.is_primary() and 2 in pg.acting
+                         and pg.state == "active"}
+                if after and all(after.get(pgid, 0) > les
+                                 for pgid, les in before.items()):
+                    break
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError(
+                        f"interval never rolled: {before} -> {after}")
+                await asyncio.sleep(0.1)
+            for i in range(8):      # cluster still fully serves
+                assert await io.read(f"o{i}") == b"x" * 2000
+        finally:
+            await c.stop()
+    run(body())
+
+
 def test_ec_delete_while_osd_down_is_not_resurrected(tmp_path):
     """A delete committed while one shard-holder is down must stay a
     delete after the holder revives: recovery pushes the DELETION to the

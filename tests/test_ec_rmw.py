@@ -261,13 +261,113 @@ def test_ec_pool_rejects_unsupported_ops(tmp_path):
         c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3)
         try:
             await io.write_full("o", b"data")
-            # xattrs are supported on EC now (reference parity);
-            # truncate/zero/omap remain gated
-            for coro in (io.truncate("o", 1), io.zero("o", 0, 1),
-                         io.omap_set("o", {"k": b"v"})):
+            # xattrs and truncate/zero are supported on EC (reference
+            # parity); omap/snaps remain gated
+            for coro in (io.omap_set("o", {"k": b"v"}),
+                         io.omap_get("o")):
                 with pytest.raises(RadosError) as ei:
                     await coro
                 assert ei.value.rc == -95
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_ec_truncate_and_zero(tmp_path):
+    """EC truncate (shrink mid-stripe, shrink aligned, grow) and zero
+    (interior + extending) against a bytearray model — the reference
+    allows both on EC pools (src/osd/PrimaryLogPG.cc do_osd_ops
+    CEPH_OSD_OP_TRUNCATE/ZERO)."""
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 2, 4)
+        try:
+            rng = random.Random(11)
+            model = bytearray(rng.randbytes(3 * W + 123))
+            await io.write_full("t", bytes(model))
+
+            async def check():
+                assert await io.read("t") == bytes(model)
+                assert (await io.stat("t"))["size"] == len(model)
+
+            # shrink mid-stripe
+            for size in (2 * W + 77, W, 5, 0):
+                await io.truncate("t", size)
+                del model[size:]
+                await check()
+            # grow from empty: hole reads as zeros
+            await io.truncate("t", W + 9)
+            model += b"\x00" * (W + 9)
+            await check()
+            # data past a shrink boundary must not resurface via RMW
+            await io.truncate("t", 0)
+            model.clear()
+            piece = rng.randbytes(W - 7)
+            await io.append("t", piece)
+            model += piece
+            await check()
+            # zero: interior, cross-stripe, extending past the end
+            for off, ln in [(3, 10), (W - 20, 40), (len(model) - 5, 60)]:
+                await io.zero("t", off, ln)
+                if off + ln > len(model):
+                    model += b"\x00" * (off + ln - len(model))
+                model[off:off + ln] = b"\x00" * ln
+                await check()
+            # truncate of a missing object is ENOENT
+            with pytest.raises(ObjectNotFound):
+                await io.truncate("absent", 10)
+            # stale tail-stripe bytes past a mid-stripe shrink must NOT
+            # resurface in the zero gap of a later past-the-end write
+            await io.write_full("g", rng.randbytes(2 * W))
+            await io.truncate("g", W + 11)
+            await io.write("g", b"XX", offset=W + 500)
+            got = await io.read("g")
+            assert got[W + 11:W + 500] == b"\x00" * (500 - 11)
+            assert got[W + 500:] == b"XX"
+            # ...including when the write lands whole stripes PAST the
+            # cut tail stripe (the gap spans stripes never read back)
+            await io.write_full("h", rng.randbytes(2 * W))
+            await io.truncate("h", 300)
+            await io.write("h", b"YY", offset=3 * W + 7)
+            goth = await io.read("h")
+            assert goth[300:3 * W + 7] == b"\x00" * (3 * W + 7 - 300)
+            assert goth[3 * W + 7:] == b"YY"
+            # and a truncate-GROW over a cut tail exposes zeros, not
+            # residue
+            await io.write_full("i", rng.randbytes(W))
+            await io.truncate("i", 100)
+            await io.truncate("i", 2 * W)
+            goti = await io.read("i")
+            assert goti[100:] == b"\x00" * (2 * W - 100)
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_ec_truncate_survives_thrash_recovery(tmp_path):
+    """A truncate committed while one shard-holder is down must hold
+    after the holder revives (recovery reconstructs at the truncated
+    version, never resurrecting the longer state)."""
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 2, 4)
+        try:
+            data = bytes(range(256)) * 64          # 16 KiB
+            await io.write_full("t", data)
+            store = c.osds[3].store
+            await c.kill_osd(3)
+            await c.wait_osd_down(3)
+            await io.truncate("t", 100)
+            await c.start_osd(3, store=store)
+            deadline = asyncio.get_running_loop().time() + 20
+            while True:
+                try:
+                    if await io.read("t") == data[:100] and \
+                            (await io.stat("t"))["size"] == 100:
+                        break
+                except RadosError:
+                    pass
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError("truncate lost after recovery")
+                await asyncio.sleep(0.25)
         finally:
             await c.stop()
     run(body())
