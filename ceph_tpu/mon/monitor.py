@@ -248,13 +248,8 @@ class OSDMonitor:
         pid = self.osdmap.pool_names.get(pool_name)
         if pid is None:
             raise ValueError(f"pool {pool_name!r} does not exist")
-        if self.osdmap.pools[pid].type != "replicated":
-            # snapshots require replicated pools here (one bad mksnap
-            # would otherwise stamp snapc on every write and brick the
-            # pool with EOPNOTSUPP)
-            raise ValueError(
-                f"pool {pool_name!r} is {self.osdmap.pools[pid].type}: "
-                f"snapshots require a replicated pool")
+        # snapshots work on both pool types: EC pools clone per-shard
+        # chunk blobs via clone sub-ops (see osd/ec_backend.py)
         pending = self.get_pending()
         base = pending.new_pools.get(pid, self.osdmap.pools[pid])
         p = _dc.replace(base, pool_snaps=dict(base.pool_snaps),

@@ -175,7 +175,8 @@ class PGBackend:
             from ceph_tpu.osd import snaps
             p = json.loads(data)
             snaps.apply_clone(self.host.store, cid, gh, self.pg._meta_gh(),
-                              p["cloneid"], p["snaps"], p["seq_only"])
+                              p["cloneid"], p["snaps"], p["seq_only"],
+                              size=p.get("size"))
             return
         elif op == "rollback":
             from ceph_tpu.osd import snaps
@@ -245,7 +246,56 @@ class PGBackend:
     def apply_push(self, oid: str, data: bytes, attrs: dict,
                    delete: bool, shard: int = -1,
                    omap: dict[str, bytes] | None = None,
-                   snap_state: dict | None = None) -> None:
+                   snap_state: dict | None = None,
+                   snap: int | None = None,
+                   ss_blob: str | None = None) -> None:
+        if snap is not None or ss_blob is not None:
+            # EC snapshot-state push: a reconstructed CLONE chunk for
+            # this position, or the replicated SnapSet for the snapdir
+            # (clones ride recovery one push per clone, like head chunks)
+            from ceph_tpu.osd import snaps
+            cid = self.coll(shard)
+            head = self.ghobject(oid, shard)
+            txn = Transaction()
+            if snap is not None:
+                cgh = snaps.clone_gh(head, snap)
+                if self.host.store.exists(cid, cgh):
+                    txn.remove(cid, cgh)
+                txn.touch(cid, cgh)
+                if data:
+                    txn.write(cid, cgh, 0, data)
+                if attrs:
+                    txn.setattrs(cid, cgh, attrs)
+            if ss_blob is not None:
+                ss = snaps.SnapSet.from_json(ss_blob.encode())
+                # the pushed SnapSet REPLACES local snapshot state:
+                # stale clone blobs (e.g. a trim that ran while this
+                # peer was down) and this object's SnapMapper keys must
+                # go, or they leak forever and re-trigger trims
+                old = snaps.load_snapset(self.host.store, cid, head)
+                keep = {c["id"] for c in ss.clones}
+                if old is not None:
+                    rm = []
+                    for clone in old.clones:
+                        rm.extend(snaps.sm_key(s, oid)
+                                  for s in clone["snaps"])
+                        if clone["id"] in keep:
+                            continue
+                        cgh = snaps.clone_gh(head, clone["id"])
+                        if self.host.store.exists(cid, cgh):
+                            txn.remove(cid, cgh)
+                    if rm:
+                        txn.omap_rmkeys(cid, self.pg._meta_gh(), rm)
+                sd = snaps.snapdir_gh(head)
+                if not self.host.store.exists(cid, sd):
+                    txn.touch(cid, sd)
+                txn.setattr(cid, sd, snaps.SS_ATTR, ss.to_json())
+                sm = {snaps.sm_key(s, oid): b"1"
+                      for clone in ss.clones for s in clone["snaps"]}
+                if sm:
+                    txn.omap_setkeys(cid, self.pg._meta_gh(), sm)
+            self.host.store.queue_transaction(txn)
+            return
         if delete:
             self.local_apply(oid, "delete", b"", shard=shard)
         else:

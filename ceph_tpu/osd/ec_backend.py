@@ -120,15 +120,25 @@ class ECBackend(PGBackend):
 
     def _verified_local_extent(
             self, oid: str, chunk_off: int, chunk_len: int,
-            prev: bool = False) -> tuple[bytes, int, int, tuple] | None:
+            prev: bool = False,
+            snap: int | None = None) -> tuple[bytes, int, int, tuple] | None:
         """Read [chunk_off, chunk_off+chunk_len) of the local shard blob
-        (or its rollback generation) with per-chunk crc verification;
-        None if absent or corrupt."""
+        (or its rollback generation, or a snap CLONE's chunk — clones
+        carry the head's attrs from clone time, so the same crc/version
+        verification applies) with per-chunk crc verification; None if
+        absent or corrupt."""
         if prev:
             oid = oid + PREV_SUFFIX
-        if not self.local_exists(oid):
-            return None
-        cid, gh = self.coll(), self.ghobject(oid)
+        cid = self.coll()
+        if snap is not None:
+            from ceph_tpu.osd import snaps as snapmod
+            gh = snapmod.clone_gh(self.ghobject(oid), snap)
+            if not self.host.store.exists(cid, gh):
+                return None
+        else:
+            if not self.local_exists(oid):
+                return None
+            gh = self.ghobject(oid)
         try:
             data = self.host.store.read(cid, gh, chunk_off,
                                         None if chunk_len < 0 else chunk_len)
@@ -247,6 +257,40 @@ class ECBackend(PGBackend):
             payloads = await self._plan_rmw(oid, op, off, data, entry, live)
             if payloads is None:        # zero-length no-op past the plan
                 return
+        elif op == "rollback":
+            # EC rollback re-asserts the CLONE'S CONTENT as a fresh full
+            # write instead of a per-shard clone-to-head copy: a shard
+            # whose clone chunk is a recovery hole would silently no-op
+            # the copy and diverge from the acting set (found in review).
+            # The gather reconstructs the clone from any k holders.
+            from ceph_tpu.osd import snaps as snapmod
+            ss = await self.gather_snapset(oid)
+            src = snapmod.resolve_read(ss, int(data), True)
+            if src is None or src == "head":
+                return                  # caller pre-resolved; no-op here
+            content = await self.execute_read(oid, 0, 0, snap=src)
+            await self._execute_write_locked(oid, "write_full", content,
+                                             entry, 0)
+            return
+        elif op == "clone":
+            # stamp the LOGICAL size into the per-shard clone record
+            # (each shard would otherwise record its chunk-blob size and
+            # list_snaps would report padded nonsense)
+            args = json.loads(data)
+            args["size"], _ = await self._current_state(oid)
+            payloads = {i: ({"op": "clone", "args": json.dumps(args),
+                             "version": list(entry.version)}, b"")
+                        for i in live}
+        elif op in ("snaptrim", "purge"):
+            # snapshot maintenance ops are deterministic per-shard STORE
+            # ops: every shard trims/purges ITS OWN chunk blobs, and the
+            # SnapSet replicates onto every shard's snapdir — exactly how
+            # chunk data and xattrs already replicate (the reference
+            # generates the same per-shard transactions in
+            # ECTransaction::generate_transactions for ec pool snaps)
+            payloads = {i: ({"op": op, "args": data.decode("latin1"),
+                             "version": list(entry.version)}, b"")
+                        for i in live}
         else:
             raise StoreError("EINVAL", f"unknown ec op {op!r}")
         await self._fan_out(oid, payloads, entry, live)
@@ -476,6 +520,8 @@ class ECBackend(PGBackend):
             self.local_apply(oid, "rmxattr", sub["name"].encode())
         elif kind == "delete":
             self.local_apply(oid, "delete", b"")
+        elif kind in ("clone", "snaptrim", "purge"):
+            self.local_apply(oid, kind, sub["args"].encode("latin1"))
         else:
             raise StoreError("EINVAL", f"unknown ec sub-op {kind!r}")
 
@@ -519,6 +565,7 @@ class ECBackend(PGBackend):
             allow_rollback: bool = False,
             chunk_off: int = 0,
             chunk_len: int = -1,
+            snap: int | None = None,
     ) -> tuple[dict[int, bytes], int, dict]:
         """Collect shard chunk EXTENTS [chunk_off, chunk_off+chunk_len)
         until a version-consistent decodable set exists; returns
@@ -559,7 +606,8 @@ class ECBackend(PGBackend):
             return None
 
         if self.host.whoami not in exclude_osds:
-            loc = self._verified_local_extent(oid, chunk_off, chunk_len)
+            loc = self._verified_local_extent(oid, chunk_off, chunk_len,
+                                              snap=snap)
             if loc is not None:
                 data, shard, size, ver = loc
                 add(shard, data, size, ver,
@@ -591,7 +639,8 @@ class ECBackend(PGBackend):
                     await self.host.send_osd(osd, MOSDECSubOpRead(
                         {"pgid": [self.pg.pgid.pool, self.pg.pgid.ps],
                          "tid": tid, "from": self.host.whoami, "oid": oid,
-                         "chunk_off": chunk_off, "chunk_len": chunk_len}))
+                         "chunk_off": chunk_off, "chunk_len": chunk_len,
+                         "snap": snap}))
                     futs.add(fut)
                 except Exception as e:
                     # unreachable peer: just a missing chunk, not a failed
@@ -734,10 +783,11 @@ class ECBackend(PGBackend):
                 self._read_waiters.pop(tid, None)
 
     async def execute_read(self, oid: str, offset: int,
-                           length: int) -> bytes:
+                           length: int, snap: int | None = None) -> bytes:
         """Ranged read: fetch only the chunk extents of touched stripes
         (the reference computes the same bounds via
-        offset_len_to_stripe_bounds, ECCommon.cc:281,503)."""
+        offset_len_to_stripe_bounds, ECCommon.cc:281,503). With `snap`,
+        the same gather runs against a snap CLONE's chunk blobs."""
         w, c = self.sinfo.stripe_width, self.sinfo.chunk_size
         first = offset // w
         if length <= 0:
@@ -746,19 +796,60 @@ class ECBackend(PGBackend):
             last = -(-(offset + length) // w)
             chunk_off, chunk_len = first * c, (last - first) * c
         got, ec_size, _ = await self._gather_chunks(
-            oid, chunk_off=chunk_off, chunk_len=chunk_len)
+            oid, chunk_off=chunk_off, chunk_len=chunk_len, snap=snap)
         data = ec_util.decode_concat(self.sinfo, self.ec_impl, got)
         start = offset - first * w
         end = (ec_size if length <= 0 else min(offset + length, ec_size)) \
             - first * w
         return data[start:max(start, end)]
 
-    async def execute_stat(self, oid: str) -> int:
-        loc = self._verified_local_extent(oid, 0, 0)
+    async def gather_snapset(self, oid: str, authoritative: bool = False):
+        """The object's SnapSet. Default (read path): local snapdir
+        first — clone sub-ops replicate it to every live shard and an
+        ACTIVE primary processes every snap mutation, so its local copy
+        is fresh — else the first live peer holding one. With
+        `authoritative` (recovery pull on a possibly-stale primary):
+        query local AND every live peer, adopt the highest seq (ties →
+        fewest clones: a same-seq divergence means this holder missed a
+        TRIM, never a clone — clones always advance seq). None = no
+        snapshot state anywhere reachable."""
+        from ceph_tpu.osd import snaps as snapmod
+        local = snapmod.load_snapset(self.host.store, self.coll(),
+                                     self.ghobject(oid))
+        if local is not None and not authoritative:
+            return local
+        found = [local] if local is not None else []
+        for idx, osd in sorted(self._live_positions().items()):
+            if osd == self.host.whoami:
+                continue
+            tid = self.new_tid()
+            fut = asyncio.get_running_loop().create_future()
+            self._read_waiters[tid] = fut
+            try:
+                await self.host.send_osd(osd, MOSDECSubOpRead(
+                    {"pgid": [self.pg.pgid.pool, self.pg.pgid.ps],
+                     "tid": tid, "from": self.host.whoami, "oid": oid,
+                     "want_ss": True}))
+                payload, _ = await asyncio.wait_for(fut, READ_TIMEOUT / 2)
+                if payload.get("ss"):
+                    ss = snapmod.SnapSet.from_json(payload["ss"].encode())
+                    if not authoritative:
+                        return ss
+                    found.append(ss)
+            except Exception:
+                continue
+            finally:
+                self._read_waiters.pop(tid, None)
+        if not found:
+            return None
+        return max(found, key=lambda ss: (ss.seq, -len(ss.clones)))
+
+    async def execute_stat(self, oid: str, snap: int | None = None) -> int:
+        loc = self._verified_local_extent(oid, 0, 0, snap=snap)
         if loc is not None:
             return loc[2]
         _, ec_size, _ = await self._gather_chunks(oid, chunk_off=0,
-                                                  chunk_len=0)
+                                                  chunk_len=0, snap=snap)
         return ec_size
 
     async def object_exists(self, oid: str) -> bool:
@@ -797,12 +888,22 @@ class ECBackend(PGBackend):
             return
         # sub-read: serve our chunk extent, crc-verified per chunk
         # (ECBackend.cc:1015 handle_sub_read, crc verify :1092)
+        if p.get("want_ss"):
+            from ceph_tpu.osd import snaps as snapmod
+            ss = snapmod.load_snapset(self.host.store, self.coll(),
+                                      self.ghobject(p["oid"]))
+            conn.send_message(MOSDECSubOpReadReply(
+                {"pgid": p["pgid"], "tid": p["tid"],
+                 "from": self.host.whoami, "oid": p["oid"],
+                 "found": ss is not None,
+                 "ss": ss.to_json().decode() if ss else None}))
+            return
         payload = {"pgid": p["pgid"], "tid": p["tid"],
                    "from": self.host.whoami, "oid": p["oid"],
                    "found": False, "shard": -1, "ec_size": -1}
         loc = self._verified_local_extent(
             p["oid"], p.get("chunk_off", 0), p.get("chunk_len", -1),
-            prev=p.get("prev", False))
+            prev=p.get("prev", False), snap=p.get("snap"))
         data = b""
         if loc is not None:
             data, shard, size, ver = loc
@@ -893,6 +994,70 @@ class ECBackend(PGBackend):
                 return ent.op == "delete"
         return False
 
+    async def _reconstruct_clone(self, oid: str, idx: int,
+                                 cloneid: int) -> tuple[bytes, dict] | None:
+        """Position `idx`'s chunk of a snap clone, reconstructed from
+        any k version-consistent clone holders; None when currently
+        unreconstructable. Callers SKIP a None (reduced clone redundancy
+        for the target, not a correctness hole: snap reads only need
+        any k holders — if k were reachable, this reconstruct would
+        have succeeded — and rollback re-asserts gathered content as a
+        full write rather than depending on per-shard clones)."""
+        try:
+            got, ec_size, meta = await self._gather_chunks(
+                oid, snap=cloneid)
+        except StoreError:
+            return None
+        if idx in got:
+            chunk = got[idx]
+        else:
+            chunk = ec_util.decode_shards(self.sinfo, self.ec_impl,
+                                          got, [idx])[idx]
+        return chunk, self._chunk_attrs(idx, ec_size, meta["version"],
+                                        self._csums(chunk))
+
+    async def _push_snap_state(self, peer: int, idx: int,
+                               oid: str) -> None:
+        """Recovery of snapshot state: the peer's positional chunk of
+        every clone, then the SnapSet (the replicated backend ships the
+        same payload inline via snap_state; clones are chunks here).
+        LOCAL snapdir only — a peer-querying gather here would cost
+        every snap-less object O(peers) round trips per recovery push;
+        the primary's own snapdir is restored by _pull_snap_state before
+        it pushes anyone else."""
+        from ceph_tpu.osd import snaps as snapmod
+        ss = snapmod.load_snapset(self.host.store, self.coll(),
+                                  self.ghobject(oid))
+        if ss is None:
+            return
+        for clone in ss.clones:
+            rec = await self._reconstruct_clone(oid, idx, clone["id"])
+            if rec is None:
+                continue
+            chunk, attrs = rec
+            await self.pg.send_push(peer, oid, chunk, attrs,
+                                    delete=False, snap=clone["id"])
+        await self.pg.send_push(peer, oid, b"", None, delete=False,
+                                ss_blob=ss.to_json().decode())
+
+    async def _pull_snap_state(self, oid: str, me: int) -> None:
+        """Primary-side snapshot-state recovery: rebuild our own
+        positional clone chunks + snapdir from the peers'. The gather
+        is AUTHORITATIVE — a primary revived after missing clone ops
+        would otherwise trust its stale local snapdir and serve wrong
+        snap resolutions (found in review)."""
+        ss = await self.gather_snapset(oid, authoritative=True)
+        if ss is None:
+            return
+        for clone in ss.clones:
+            rec = await self._reconstruct_clone(oid, me, clone["id"])
+            if rec is None:
+                continue
+            chunk, attrs = rec
+            self.apply_push(oid, chunk, attrs, False, snap=clone["id"])
+        self.apply_push(oid, b"", None, False,
+                        ss_blob=ss.to_json().decode())
+
     async def push_object(self, peer: int, oid: str) -> None:
         """Reconstruct `peer`'s positional chunk from k survivors and
         push it (the reference recovery reads min-to-decode and
@@ -901,6 +1066,7 @@ class ECBackend(PGBackend):
             idx = self.pg.acting.index(peer)
         except ValueError:
             return
+        await self._push_snap_state(peer, idx, oid)
         if self._log_tombstoned(oid):
             await self.pg.send_push(peer, oid, b"", None, delete=True)
             return
@@ -929,6 +1095,7 @@ class ECBackend(PGBackend):
         chunk is a different position; the gather already consults every
         live shard, so `fallbacks` is implicit here)."""
         me = self.pg.acting.index(self.host.whoami)
+        await self._pull_snap_state(oid, me)
         if self._log_tombstoned(oid):
             # authoritative history deleted it (belt-and-braces: the
             # caller's ZERO-need tombstone normally catches this)

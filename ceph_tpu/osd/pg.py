@@ -221,19 +221,15 @@ class PGInstance:
         objects whose clones/snapdir survive a head delete."""
         from ceph_tpu.osd import snaps
         names = set(self.list_objects())
-        if self.pool.type == "replicated":
-            names |= snaps.headless_snap_objects(self.host.store,
-                                                 self.backend.coll())
+        names |= snaps.headless_snap_objects(self.host.store,
+                                             self.backend.coll())
         names.discard(PGMETA_OID)
         return sorted(names)
 
     def _purge_stray(self, oid: str) -> None:
         """Drop a stray object found during backfill: unlike a client
         delete, its snapshot state goes with it."""
-        if self.pool.type == "replicated":
-            self.backend.local_apply(oid, "purge", b"")
-        else:
-            self.backend.local_apply(oid, "delete", b"")
+        self.backend.local_apply(oid, "purge", b"")
 
     # -- map advance ---------------------------------------------------------
 
@@ -614,7 +610,9 @@ class PGInstance:
     async def send_push(self, peer: int, oid: str, data: bytes,
                         attrs: dict | None, delete: bool,
                         omap: dict | None = None,
-                        snap_state: dict | None = None) -> None:
+                        snap_state: dict | None = None,
+                        snap: int | None = None,
+                        ss_blob: str | None = None) -> None:
         payload = {"pgid": [self.pgid.pool, self.pgid.ps], "op": "push",
                    "from": self.host.whoami, "oid": oid, "delete": delete}
         if attrs:
@@ -625,6 +623,10 @@ class PGInstance:
                                for k, v in omap.items()}
         if snap_state is not None:
             payload["snap_state"] = snap_state
+        if snap is not None:        # EC: this push carries a CLONE chunk
+            payload["snap"] = snap
+        if ss_blob is not None:     # EC: replicate the SnapSet/snapdir
+            payload["ss"] = ss_blob
         await self.host.send_osd(peer, MOSDPGPush(payload, data))
 
     # -- peering message handlers (both roles) -------------------------------
@@ -678,8 +680,12 @@ class PGInstance:
         omap = ({k: v.encode("latin1") for k, v in p["omap"].items()}
                 if "omap" in p else None)
         self.backend.apply_push(p["oid"], msg.data, attrs, p["delete"],
-                                omap=omap, snap_state=p.get("snap_state"))
-        self.log.mark_recovered(p["oid"])
+                                omap=omap, snap_state=p.get("snap_state"),
+                                snap=p.get("snap"), ss_blob=p.get("ss"))
+        if p.get("snap") is None and p.get("ss") is None:
+            # only the HEAD push resolves the missing record: clone/
+            # snapdir pushes are auxiliary state for the same object
+            self.log.mark_recovered(p["oid"])
         if p.get("reply_to") == "pull":
             fut = self._push_waiters.get(f"pull:{p['oid']}")
             if fut is not None and not fut.done():
@@ -695,8 +701,7 @@ class PGInstance:
         """Start trimming snaps the monitor has removed (pool
         removed_snaps vs our purged set) — called on activation and on
         every map advance that updates the pool record."""
-        if (self.pool.type != "replicated" or not self.is_primary()
-                or self.state != "active"):
+        if not self.is_primary() or self.state != "active":
             return
         todo = set(getattr(self.pool, "removed_snaps", ())) \
             - self.purged_snaps
@@ -812,13 +817,13 @@ class PGInstance:
                          "zero", "create", "delete", "setxattr", "rmxattr",
                          "omap_set", "omap_rm", "rollback", "snaptrim"})
     # the reference rejects omap on EC pools (PrimaryLogPG.cc
-    # pool.info.supports_omap()); snapshots require replicated pools
-    # here, like pre-overwrite EC in the reference. truncate/zero ride
-    # the EC write plan (per-shard truncate sub-ops / zero-fill RMW).
-    # User xattrs replicate onto every shard, like the reference.
+    # pool.info.supports_omap()). truncate/zero ride the EC write plan
+    # (per-shard truncate sub-ops / zero-fill RMW); snapshots work via
+    # per-shard clone/rollback/trim sub-ops with the SnapSet replicated
+    # onto every shard's snapdir. User xattrs replicate onto every
+    # shard, like the reference.
     EC_UNSUPPORTED = frozenset({"omap_set", "omap_rm", "omap_get",
-                                "omap_vals",
-                                "rollback", "snaptrim", "list_snaps"})
+                                "omap_vals"})
 
     async def do_op(self, op: dict, data: bytes,
                     conn=None) -> tuple[int, dict, bytes]:
@@ -834,9 +839,7 @@ class PGInstance:
         mark_op_event("started")
         oid = op["oid"]
         kind = op["op"]
-        if self.pool.type == "erasure" and (
-                kind in self.EC_UNSUPPORTED
-                or op.get("snapc") or op.get("snapid") is not None):
+        if self.pool.type == "erasure" and kind in self.EC_UNSUPPORTED:
             return -95, {"error": f"EOPNOTSUPP: {kind} on an ec pool"}, b""
 
         if kind in self.MOD_OPS:
@@ -844,7 +847,7 @@ class PGInstance:
 
         snapid = op.get("snapid")
         if snapid is not None and kind in ("read", "stat"):
-            return self._do_snap_read(kind, oid, op, snapid)
+            return await self._do_snap_read(kind, oid, op, snapid)
 
         if kind == "read":
             try:
@@ -861,9 +864,14 @@ class PGInstance:
             return 0, {"size": size}, b""
         if kind == "list_snaps":
             from ceph_tpu.osd import snaps
-            ss = snaps.load_snapset(self.host.store, self.backend.coll(),
-                                    self.backend.ghobject(oid))
-            head_exists = self.backend.local_exists(oid)
+            if self.pool.type == "erasure":
+                ss = await self.backend.gather_snapset(oid)
+                head_exists = await self.backend.object_exists(oid)
+            else:
+                ss = snaps.load_snapset(self.host.store,
+                                        self.backend.coll(),
+                                        self.backend.ghobject(oid))
+                head_exists = self.backend.local_exists(oid)
             if ss is None and not head_exists:
                 return -2, {"error": "ENOENT"}, b""
             return 0, {"seq": ss.seq if ss else 0,
@@ -1105,13 +1113,38 @@ class PGInstance:
             oid, chunk_off=0, chunk_len=0)
         return meta.get("uattrs", {})
 
-    def _do_snap_read(self, kind: str, oid: str, op: dict,
-                      snapid: int) -> tuple[int, dict, bytes]:
+    async def _do_snap_read(self, kind: str, oid: str, op: dict,
+                            snapid: int) -> tuple[int, dict, bytes]:
         """Snap-directed read/stat (find_object_context: head, covering
-        clone, or ENOENT when the object did not exist at that snap)."""
+        clone, or ENOENT when the object did not exist at that snap).
+        On EC pools the clone is striped like the head: resolution uses
+        the replicated snapdir, the data comes from a clone-chunk
+        gather + decode."""
         from ceph_tpu.osd import snaps
         store, cid = self.host.store, self.backend.coll()
         head = self.backend.ghobject(oid)
+        if self.pool.type == "erasure":
+            ss = await self.backend.gather_snapset(oid)
+            if ss is not None and snapid <= ss.seq:
+                # clone resolution never consults head existence: skip
+                # that gather (it costs a cluster round trip when the
+                # primary's local chunk is missing)
+                head_exists = False
+            else:
+                head_exists = await self.backend.object_exists(oid)
+            src = snaps.resolve_read(ss, snapid, head_exists)
+            if src is None:
+                return -2, {"error": f"ENOENT at snap {snapid}"}, b""
+            off, ln = op.get("off", 0), op.get("len", 0)
+            snap = None if src == "head" else src
+            try:
+                if kind == "stat":
+                    return 0, {"size": await self.backend.execute_stat(
+                        oid, snap=snap)}, b""
+                return 0, {}, await self.backend.execute_read(
+                    oid, off, ln, snap=snap)
+            except StoreError as e:
+                return self._store_rc(e), {"error": str(e)}, b""
         ss = snaps.load_snapset(store, cid, head)
         src = snaps.resolve_read(ss, snapid, store.exists(cid, head))
         if src is None:
@@ -1187,11 +1220,15 @@ class PGInstance:
         if kind == "rollback":
             from ceph_tpu.osd import snaps as snapmod
             head = self.backend.ghobject(oid)
-            ss = snapmod.load_snapset(self.host.store, self.backend.coll(),
-                                      head)
-            if snapmod.resolve_read(
-                    ss, op["snapid"],
-                    self.backend.local_exists(oid)) is None:
+            if self.pool.type == "erasure":
+                ss = await self.backend.gather_snapset(oid)
+                head_exists = await self.backend.object_exists(oid)
+            else:
+                ss = snapmod.load_snapset(self.host.store,
+                                          self.backend.coll(), head)
+                head_exists = self.backend.local_exists(oid)
+            if snapmod.resolve_read(ss, op["snapid"],
+                                    head_exists) is None:
                 return -2, {"error": f"ENOENT at snap {op['snapid']}"}, b""
             data = str(op["snapid"]).encode()
         elif kind == "snaptrim":
@@ -1200,8 +1237,7 @@ class PGInstance:
         # snaps appear in the client's SnapContext preserves the current
         # state as a clone, via its own logged+replicated op
         snapc = op.get("snapc")
-        if (snapc and snapc.get("snaps")
-                and self.pool.type == "replicated" and kind != "snaptrim"):
+        if snapc and snapc.get("snaps") and kind != "snaptrim":
             await self._make_writeable(oid, snapc, op.get("reqid"))
         if kind == "zero":
             # re-executed on replicas: the length rides the data segment
@@ -1239,13 +1275,16 @@ class PGInstance:
     async def _make_writeable(self, oid: str, snapc: dict,
                               reqid) -> None:
         from ceph_tpu.osd import snaps as snapmod
-        ss = snapmod.load_snapset(self.host.store, self.backend.coll(),
-                                  self.backend.ghobject(oid))
+        if self.pool.type == "erasure":
+            ss = await self.backend.gather_snapset(oid)
+        else:
+            ss = snapmod.load_snapset(self.host.store, self.backend.coll(),
+                                      self.backend.ghobject(oid))
         seq = ss.seq if ss else 0
         new = [s for s in snapc["snaps"] if s > seq]
         if not new:
             return
-        head_exists = self.backend.local_exists(oid)
+        head_exists = await self.backend.object_exists(oid)
         payload = json.dumps({"cloneid": max(new), "snaps": sorted(new),
                               "seq_only": not head_exists}).encode()
         entry = LogEntry(version=self.next_version(), op="modify", oid=oid,

@@ -111,12 +111,14 @@ def resolve_read(ss: SnapSet | None, snapid: int,
 
 def apply_clone(store: ObjectStore, cid: CollectionId, head: Ghobject,
                 pgmeta: Ghobject, cloneid: int, snaps: list[int],
-                seq_only: bool) -> None:
+                seq_only: bool, size: int | None = None) -> None:
     """make_writeable's clone step: preserve the current head state as
     clone `cloneid` covering `snaps`, and advance SnapSet.seq. With
     seq_only (head absent at clone time: nothing to preserve) only the
     seq advances, so a later clone cannot claim to cover snaps that
-    predate the object."""
+    predate the object. `size` overrides the recorded clone size (EC
+    shards pass the LOGICAL object size; their local blob is a padded
+    chunk stack)."""
     ss = load_snapset(store, cid, head) or SnapSet()
     if cloneid <= ss.seq:
         return                               # replayed / stale clone op
@@ -126,7 +128,8 @@ def apply_clone(store: ObjectStore, cid: CollectionId, head: Ghobject,
         if store.exists(cid, cgh):
             txn.remove(cid, cgh)
         txn.clone(cid, head, cgh)
-        size = store.stat(cid, head)["size"]
+        if size is None:
+            size = store.stat(cid, head)["size"]
         ss.clones.append({"id": cloneid, "snaps": sorted(snaps),
                           "size": size})
         txn.omap_setkeys(cid, pgmeta,
@@ -137,10 +140,14 @@ def apply_clone(store: ObjectStore, cid: CollectionId, head: Ghobject,
 
 
 def apply_rollback(store: ObjectStore, cid: CollectionId, head: Ghobject,
-                   snapid: int) -> None:
+                   snapid: int,
+                   extra_attrs: dict[str, bytes] | None = None) -> None:
     """Copy the clone covering `snapid` back over head (rollback op,
     PrimaryLogPG::_rollback_to). The primary rejects ENOENT resolutions
-    before logging, so an unresolvable replay is a no-op."""
+    before logging, so an unresolvable replay is a no-op. `extra_attrs`
+    are stamped onto the restored head (the EC backend re-stamps the
+    shard's version attr so the rolled-back chunks carry the rollback
+    entry's eversion, not the clone-time one)."""
     ss = load_snapset(store, cid, head)
     src = resolve_read(ss, snapid, store.exists(cid, head))
     if src is None or src == "head":
@@ -152,6 +159,8 @@ def apply_rollback(store: ObjectStore, cid: CollectionId, head: Ghobject,
     if store.exists(cid, head):
         txn.remove(cid, head)
     txn.clone(cid, cgh, head)
+    if extra_attrs:
+        txn.setattrs(cid, head, extra_attrs)
     store.queue_transaction(txn)
 
 

@@ -41,10 +41,11 @@ class ModelRunner:
         self.uncertain: dict[str, tuple] = {}
         self.ops_run = 0
         self.uncertain_ops = 0
-        # snapshots (replicated pools): name -> {"id", "state": whole
-        # model at snap time}; taken only while the model is exact, so
-        # snap reads verify EXACTLY — clones must survive thrashing
-        self.enable_snaps = enable_snaps and not ec_pool
+        # snapshots (both pool types: EC clones per-shard chunks):
+        # name -> {"id", "state": whole model at snap time}; taken only
+        # while the model is exact, so snap reads verify EXACTLY —
+        # clones must survive thrashing
+        self.enable_snaps = enable_snaps
         self.snaps: dict[str, dict] = {}
         self._snap_seq_names = 0
         self.snap_ops = 0

@@ -122,6 +122,32 @@ def test_model_no_thrash_is_exact(tmp_path):
     run(body())
 
 
+def test_model_ec_with_snapshots_thrashed(tmp_path):
+    """EC pool + self-managed snapshots under OSD kill/revive: clone
+    sub-ops, snap-directed gathers, rollback, and clone recovery all
+    race failover; every live snapshot's state must verify exactly."""
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=4)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.command({"prefix": "osd erasure-code-profile set",
+                              "name": "prof",
+                              "profile": {"plugin": "jerasure", "k": "2",
+                                          "m": "2"}})
+            await cl.pool_create("ecsnap", pg_num=4, pool_type="erasure",
+                                 erasure_code_profile="prof")
+            runner, thrasher = await _drive(
+                c, cl, cl.ioctx("ecsnap"), ec_pool=True, seed=1212,
+                n_ops=120, enable_snaps=True)
+            assert thrasher.kills >= 1
+            assert runner.snap_ops >= 3, \
+                f"snapshot ops never exercised ({runner.snap_ops})"
+        finally:
+            await c.stop()
+    run(body())
+
+
 def test_model_with_snapshots_thrashed(tmp_path):
     """Random writes interleaved with self-managed snapshot create/
     remove/read-at-snap while OSDs die and revive: every live

@@ -182,9 +182,12 @@ def test_snaps_survive_osd_failure_and_recovery(tmp_path):
     run(body())
 
 
-def test_snap_ops_rejected_on_ec_pool(tmp_path):
+def test_snap_reads_work_on_ec_pool(tmp_path):
+    """EC pools support snapshots now (clone-on-write per shard — see
+    tests/test_ec_snaps.py for the full matrix); a read at an
+    unknown snapid answers ENOENT, never EOPNOTSUPP."""
     async def body():
-        c = ClusterHarness(tmp_path)
+        c = ClusterHarness(tmp_path, n_osds=3)
         try:
             await c.start()
             cl = await c.client()
@@ -196,10 +199,13 @@ def test_snap_ops_rejected_on_ec_pool(tmp_path):
                                  erasure_code_profile="t21")
             io = cl.ioctx("ecs")
             await io.write_full("x", b"data")
-            from ceph_tpu.rados.client import RadosError
-            with pytest.raises(RadosError) as ei:
-                await io.read("x", snapid=1)
-            assert ei.value.rc == -95
+            sid = await io.selfmanaged_snap_create()
+            io.set_snap_context(sid, [sid])
+            await io.write_full("x", b"newer")
+            assert await io.read("x", snapid=sid) == b"data"
+            from ceph_tpu.rados.client import ObjectNotFound
+            with pytest.raises(ObjectNotFound):
+                await io.read("never", snapid=sid)
         finally:
             await c.stop()
     run(body())
