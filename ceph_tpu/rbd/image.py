@@ -57,12 +57,18 @@ class RBD:
     @staticmethod
     async def create(ioctx: IoCtx, name: str, size: int,
                      order: int = DEFAULT_ORDER,
-                     parent: dict | None = None) -> None:
+                     parent: dict | None = None,
+                     data_pool: str | None = None) -> None:
+        """`data_pool` puts the DATA objects in a different (typically
+        erasure-coded) pool while the header stays in this replicated
+        pool — the reference's `rbd create --data-pool` EC layout
+        (librbd image-meta data_pool_id)."""
         if not 12 <= order <= 26:
             raise ValueError(f"order {order} out of range 12..26")
         hdr = {"name": name, "size": int(size), "order": order,
                "object_prefix": f"rbd_data.{name}",
-               "snap_seq": 0, "snaps": {}, "parent": parent}
+               "snap_seq": 0, "snaps": {}, "parent": parent,
+               "data_pool": data_pool}
         oid = _header_oid(name)
         try:
             # one message, two ops: exclusive create + header write run
@@ -80,9 +86,11 @@ class RBD:
 
     @staticmethod
     async def clone(ioctx: IoCtx, parent_name: str, snap_name: str,
-                    child_name: str) -> None:
+                    child_name: str,
+                    data_pool: str | None = None) -> None:
         """Layered clone of parent@snap (librbd::clone): the child
-        starts empty; reads fall through to the parent's snapshot."""
+        starts empty; reads fall through to the parent's snapshot. The
+        child inherits the parent's data pool unless one is given."""
         parent = await Image.open(ioctx, parent_name)
         try:
             snap = parent.header["snaps"].get(snap_name)
@@ -92,7 +100,9 @@ class RBD:
             await RBD.create(
                 ioctx, child_name, snap["size"], order=parent.order,
                 parent={"image": parent_name, "snap_name": snap_name,
-                        "snap_id": snap["id"], "overlap": snap["size"]})
+                        "snap_id": snap["id"], "overlap": snap["size"]},
+                data_pool=data_pool
+                or parent.header.get("data_pool"))
         finally:
             await parent.close()
 
@@ -114,7 +124,7 @@ class RBD:
             n_objs = -(-img.size // img.object_size) if img.size else 0
             for i in range(n_objs):
                 try:
-                    await img.ioctx.remove(img._data_oid(i))
+                    await img.data_ioctx.remove(img._data_oid(i))
                 except ObjectNotFound:
                     pass
             await img.ioctx.remove(_header_oid(name))
@@ -128,9 +138,14 @@ class Image:
 
     def __init__(self, ioctx: IoCtx, header: dict,
                  snap_name: str | None = None):
-        # a PRIVATE IoCtx: the image owns its write SnapContext
-        # (librbd's per-ImageCtx snapc) without clobbering the caller's
+        # PRIVATE IoCtxs: the image owns its write SnapContext
+        # (librbd's per-ImageCtx snapc) without clobbering the caller's.
+        # Data objects may live in a separate (EC) pool; the snapc
+        # applies to DATA only — header rewrites never clone
         self.ioctx = IoCtx(ioctx.client, ioctx.pool_name)
+        self.data_ioctx = IoCtx(ioctx.client,
+                                header.get("data_pool")
+                                or ioctx.pool_name)
         self.header = header
         # pre-snapshot headers lack these fields
         header.setdefault("snaps", {})
@@ -207,7 +222,7 @@ class Image:
         clones-on-write against the newest image snap)."""
         ids = sorted((s["id"] for s in self.header.get("snaps", {})
                       .values()), reverse=True)
-        self.ioctx.set_snap_context(
+        self.data_ioctx.set_snap_context(
             self.header.get("snap_seq", 0) if ids else 0, ids)
 
     async def refresh(self) -> None:
@@ -298,12 +313,12 @@ class Image:
         base = await self._read_parent(idx, 0, self.object_size)
         base = base.rstrip(b"\0")
         if base:
-            await self.ioctx.write(self._data_oid(idx), base, offset=0)
+            await self.data_ioctx.write(self._data_oid(idx), base, offset=0)
         else:
             # parent reads as zeros here: an empty child object still
             # must exist to stop future parent fall-through after the
             # partial write below extends it
-            await self.ioctx.create(self._data_oid(idx),
+            await self.data_ioctx.create(self._data_oid(idx),
                                     exclusive=False)
 
     # -- I/O -----------------------------------------------------------------
@@ -318,12 +333,12 @@ class Image:
         for idx, ooff, n in self._extents(offset, length):
             try:
                 if self.snap_id is not None:
-                    data = await self.ioctx.read(
+                    data = await self.data_ioctx.read(
                         self._data_oid(idx), offset=ooff, length=n,
                         snapid=self.snap_id)
                 else:
-                    data = await self.ioctx.read(self._data_oid(idx),
-                                                 offset=ooff, length=n)
+                    data = await self.data_ioctx.read(
+                        self._data_oid(idx), offset=ooff, length=n)
                 parts.append(data + b"\0" * (n - len(data)))
             except ObjectNotFound:
                 # falls through to the snap-pinned parent for views,
@@ -340,7 +355,7 @@ class Image:
         if idx in self._present:
             return False
         try:
-            await self.ioctx.stat(self._data_oid(idx))
+            await self.data_ioctx.stat(self._data_oid(idx))
             self._present.add(idx)
             return False
         except ObjectNotFound:
@@ -357,7 +372,7 @@ class Image:
                     and await self._object_absent(idx):
                 await self._copyup(idx)
             rel = (idx * self.object_size + ooff) - offset
-            await self.ioctx.write(self._data_oid(idx),
+            await self.data_ioctx.write(self._data_oid(idx),
                                    data[rel:rel + n], offset=ooff)
             self._present.add(idx)
         return len(data)
@@ -368,12 +383,12 @@ class Image:
         end do too, so only the overlap with the stored extent is
         rewritten."""
         try:
-            stored = (await self.ioctx.stat(self._data_oid(idx)))["size"]
+            stored = (await self.data_ioctx.stat(self._data_oid(idx)))["size"]
         except ObjectNotFound:
             return
         n = min(n, stored - ooff)
         if n > 0:
-            await self.ioctx.write(self._data_oid(idx), b"\0" * n,
+            await self.data_ioctx.write(self._data_oid(idx), b"\0" * n,
                                    offset=ooff)
 
     def _parent_covers(self, idx: int) -> bool:
@@ -390,7 +405,7 @@ class Image:
             if ooff == 0 and n == self.object_size \
                     and not self._parent_covers(idx):
                 try:
-                    await self.ioctx.remove(self._data_oid(idx))
+                    await self.data_ioctx.remove(self._data_oid(idx))
                 except ObjectNotFound:
                     pass
                 self._present.discard(idx)
@@ -400,7 +415,7 @@ class Image:
                 if not (ooff == 0 and n == self.object_size) \
                         and await self._object_absent(idx):
                     await self._copyup(idx)
-                await self.ioctx.write(self._data_oid(idx), b"\0" * n,
+                await self.data_ioctx.write(self._data_oid(idx), b"\0" * n,
                                        offset=ooff)
                 self._present.add(idx)
             else:
@@ -416,7 +431,7 @@ class Image:
                 n_objs = -(-old_size // S)
                 for i in range(first_dead, n_objs):
                     try:
-                        await self.ioctx.remove(self._data_oid(i))
+                        await self.data_ioctx.remove(self._data_oid(i))
                     except ObjectNotFound:
                         pass
                     self._present.discard(i)
@@ -441,7 +456,7 @@ class Image:
         async with self._hdr_lock:
             if snap_name in self.header["snaps"]:
                 raise RadosError(-17, f"snap {snap_name!r} exists")
-            snapid = await self.ioctx.selfmanaged_snap_create()
+            snapid = await self.data_ioctx.selfmanaged_snap_create()
             # pin the parent linkage AS OF the snapshot: flatten (or a
             # shrinking resize clamping the overlap) must not turn this
             # snap's parent-backed reads into zeros later
@@ -463,7 +478,7 @@ class Image:
             await self._write_header()
             self._apply_snapc()
             # the OSDs trim the per-object clones in the background
-            await self.ioctx.selfmanaged_snap_rm(snap["id"])
+            await self.data_ioctx.selfmanaged_snap_rm(snap["id"])
         await self._notify_header()
 
     def snap_list(self) -> dict[str, dict]:
@@ -480,13 +495,13 @@ class Image:
         for idx in range(n_objs):
             oid = self._data_oid(idx)
             try:
-                await self.ioctx.rollback(oid, snap["id"])
+                await self.data_ioctx.rollback(oid, snap["id"])
             except RadosError as e:
                 if e.rc != -2:
                     raise
                 # object did not exist at the snap: drop the head copy
                 try:
-                    await self.ioctx.remove(oid)
+                    await self.data_ioctx.remove(oid)
                 except ObjectNotFound:
                     pass
                 self._present.discard(idx)
@@ -510,7 +525,7 @@ class Image:
                 base = await self._read_parent(idx, 0, S)
                 base = base.rstrip(b"\0")
                 if base:
-                    await self.ioctx.write(self._data_oid(idx), base,
+                    await self.data_ioctx.write(self._data_oid(idx), base,
                                            offset=0)
         async with self._hdr_lock:
             self.header["parent"] = None

@@ -8,7 +8,7 @@ Usage:
     python -m ceph_tpu.tools.rbd_cli -m HOST:PORT [-p POOL] CMD...
 
 Commands:
-    create NAME SIZE_MB [--order N]
+    create NAME SIZE_MB [--order N] [--data-pool POOL]
     ls
     info NAME
     rm NAME
@@ -76,7 +76,8 @@ async def _run(args) -> int:
         rest = args.cmd[1:]
         if cmd == "create":
             await RBD.create(io, rest[0], int(float(rest[1]) * MB),
-                             order=args.order or DEFAULT_ORDER)
+                             order=args.order or DEFAULT_ORDER,
+                             data_pool=getattr(args, "data_pool", None))
         elif cmd == "ls":
             for name in await RBD.list(io):
                 print(name)
@@ -177,6 +178,8 @@ def main(argv=None) -> int:
     p.add_argument("-m", "--mon", required=True, help="HOST:PORT")
     p.add_argument("-p", "--pool", default="rbd")
     p.add_argument("--order", type=int, default=0)
+    p.add_argument("--data-pool", default=None,
+                   help="separate (EC) pool for data objects")
     p.add_argument("cmd", nargs="+")
     args = p.parse_args(argv)
     return asyncio.run(asyncio.wait_for(_run(args), 120))

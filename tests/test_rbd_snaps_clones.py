@@ -191,3 +191,69 @@ def test_header_watch_invalidation(tmp_path):
         finally:
             await c.stop()
     run(body())
+
+
+def test_image_on_ec_data_pool(tmp_path):
+    """`rbd create --data-pool <ec>` layout: header + metadata in the
+    replicated pool, data objects striped into an EC pool — snapshots,
+    rollback, and layered clones included (the reference's flagship EC
+    use case, librbd data_pool_id)."""
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=4)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=8, size=3)
+            await cl.command({"prefix": "osd erasure-code-profile set",
+                              "name": "rbdec",
+                              "profile": {"plugin": "jerasure", "k": "2",
+                                          "m": "2"}})
+            await cl.pool_create("ecdata", pg_num=4,
+                                 pool_type="erasure",
+                                 erasure_code_profile="rbdec")
+            io = cl.ioctx("rbd")
+            await RBD.create(io, "img", 8 * MB, order=20,
+                             data_pool="ecdata")
+            img = await Image.open(io, "img")
+            await img.write(0, b"gen1" * 1000)
+            await img.write(3 * MB + 77, b"tail" * 100)
+            assert await img.read(0, 4000) == b"gen1" * 1000
+            assert await img.read(3 * MB + 77, 400) == b"tail" * 100
+
+            # the data objects really live in the EC pool
+            ec_objs = await cl.ioctx("ecdata").list_objects()
+            assert any(o.startswith("rbd_data.img") for o in ec_objs)
+            rbd_objs = await io.list_objects()
+            assert not any(o.startswith("rbd_data.img")
+                           for o in rbd_objs)
+
+            # snapshots ride the EC pool's clone-on-write
+            await img.snap_create("s1")
+            await img.write(0, b"gen2" * 1000)
+            assert await img.read(0, 4000) == b"gen2" * 1000
+            at = await Image.open(io, "img", snap_name="s1")
+            assert await at.read(0, 4000) == b"gen1" * 1000
+            await at.close()
+            await img.snap_rollback("s1")
+            assert await img.read(0, 4000) == b"gen1" * 1000
+
+            # layered clone: child data also in the EC pool
+            await img.snap_create("base")
+            await RBD.clone(io, "img", "base", "child")
+            child = await Image.open(io, "child")
+            assert child.header.get("data_pool") == "ecdata"
+            assert await child.read(0, 4000) == b"gen1" * 1000
+            await child.write(100, b"X" * 8)
+            assert (await child.read(0, 4000))[100:108] == b"X" * 8
+            assert await img.read(100, 8) != b"X" * 8
+            await child.close()
+
+            await RBD.remove(io, "child")
+            await img.close()
+            await RBD.remove(io, "img")
+            assert not any(o.startswith("rbd_data.img")
+                           for o in await cl.ioctx(
+                               "ecdata").list_objects())
+        finally:
+            await c.stop()
+    run(body())
