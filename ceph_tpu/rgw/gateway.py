@@ -15,7 +15,8 @@ process_request -> RGWOp handlers -> RADOS store driver):
                HEAD /bucket/key   stat
                DELETE /bucket/key remove
 
-Layout in RADOS: one data pool; bucket index object
+Layout in RADOS: an index pool (+ optionally a separate, typically
+erasure-coded, DATA pool for object/part blobs); bucket index object
 `.bucket.<name>` whose omap maps object key -> JSON {size, etag};
 object data in `<bucket>/<key>`. Multi-op semantics match S3's
 read-after-write for new objects.
@@ -52,11 +53,15 @@ def _data_oid(bucket: str, key: str) -> str:
 
 
 class RGWGateway:
-    """HTTP/1.0 S3-subset frontend bound to one RADOS pool."""
+    """HTTP/1.0 S3-subset frontend bound to one RADOS pool; object
+    DATA may live in a separate (typically erasure-coded) pool while
+    bucket indexes stay in the replicated index pool — the reference's
+    placement-target data_pool split (rgw zone placement pools)."""
 
     def __init__(self, ioctx: IoCtx, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, data_ioctx: IoCtx | None = None):
         self.io = ioctx
+        self.data_io = data_ioctx if data_ioctx is not None else ioctx
         self.host, self.port = host, port
         self._server: asyncio.Server | None = None
         self.addr: tuple[str, int] | None = None
@@ -241,7 +246,7 @@ class RGWGateway:
             return 404, {}, b"NoSuchBucket"
         from ceph_tpu.native import ec_native
         etag = f"{ec_native.crc32c(body):08x}"
-        await self.io.write_full(_data_oid(bucket, key), body)
+        await self.data_io.write_full(_data_oid(bucket, key), body)
         # bucket index update AFTER the data lands (the reference's
         # cls_rgw index transaction orders prepare/complete likewise)
         await self.io.omap_set(_index_oid(bucket), {
@@ -265,7 +270,7 @@ class RGWGateway:
                 rng = (None, int(end_s))      # suffix: last N bytes
         try:
             if rng is not None:
-                st = await self.io.stat(oid)
+                st = await self.data_io.stat(oid)
                 total = st["size"]
                 start, end = rng
                 if start is None:
@@ -279,12 +284,12 @@ class RGWGateway:
                 if start >= total or start > end:
                     return 416, {"Content-Range": f"bytes */{total}"
                                  }, b"InvalidRange"
-                data = await self.io.read(oid, offset=start,
+                data = await self.data_io.read(oid, offset=start,
                                           length=end - start + 1)
                 return 206, {
                     "Content-Range": f"bytes {start}-{end}/{total}",
                     "Content-Type": "application/octet-stream"}, data
-            data = await self.io.read(oid)
+            data = await self.data_io.read(oid)
         except ObjectNotFound:
             return 404, {}, b"NoSuchKey"
         from ceph_tpu.native import ec_native
@@ -294,7 +299,7 @@ class RGWGateway:
     async def _head_object(self, bucket: str,
                            key: str) -> tuple[int, dict, bytes]:
         try:
-            st = await self.io.stat(_data_oid(bucket, key))
+            st = await self.data_io.stat(_data_oid(bucket, key))
         except ObjectNotFound:
             return 404, {}, b""
         # HEAD: the real object size IS the Content-Length (no body)
@@ -303,7 +308,7 @@ class RGWGateway:
     async def _delete_object(self, bucket: str,
                              key: str) -> tuple[int, dict, bytes]:
         try:
-            await self.io.remove(_data_oid(bucket, key))
+            await self.data_io.remove(_data_oid(bucket, key))
         except ObjectNotFound:
             return 404, {}, b"NoSuchKey"
         await self.io.omap_rm(_index_oid(bucket), [key])
@@ -356,12 +361,12 @@ class RGWGateway:
             return 400, {}, b"InvalidPartNumber"
         from ceph_tpu.native import ec_native
         etag = f"{ec_native.crc32c(body):08x}"
-        await self.io.write_full(self._part_oid(upload_id, n), body)
+        await self.data_io.write_full(self._part_oid(upload_id, n), body)
         return 200, {"ETag": f'"{etag}"'}, b""
 
     async def _upload_parts(self, upload_id: str) -> list[str]:
         prefix = f".mp.{upload_id}."
-        return sorted(o for o in await self.io.list_objects()
+        return sorted(o for o in await self.data_io.list_objects()
                       if o.startswith(prefix)
                       and not o.endswith(".meta"))
 
@@ -387,11 +392,11 @@ class RGWGateway:
         crc = 0xFFFFFFFF
         dst = _data_oid(bucket, key)
         for i, oid in enumerate(parts):
-            blob = await self.io.read(oid)
+            blob = await self.data_io.read(oid)
             if i == 0:
-                await self.io.write_full(dst, blob)
+                await self.data_io.write_full(dst, blob)
             else:
-                await self.io.write(dst, blob, offset=total)
+                await self.data_io.write(dst, blob, offset=total)
             crc = ec_native.crc32c(blob, crc)
             total += len(blob)
         etag = f"{crc:08x}-{len(parts)}"
@@ -399,7 +404,7 @@ class RGWGateway:
             key: json.dumps({"size": total, "etag": etag}).encode()})
         for oid in parts:
             try:
-                await self.io.remove(oid)
+                await self.data_io.remove(oid)
             except ObjectNotFound:
                 pass
         await self.io.remove(self._upload_meta_oid(upload_id))
@@ -417,7 +422,7 @@ class RGWGateway:
             return 404, {}, b"NoSuchUpload"
         for oid in await self._upload_parts(upload_id):
             try:
-                await self.io.remove(oid)
+                await self.data_io.remove(oid)
             except ObjectNotFound:
                 pass
         await self.io.remove(self._upload_meta_oid(upload_id))

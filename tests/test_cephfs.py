@@ -20,10 +20,13 @@ from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
 class FSHarness(ClusterHarness):
     """Cluster + pools + one MDS rank."""
 
-    async def start_fs(self) -> MDSDaemon:
+    async def start_fs(self, data_pool_opts: dict | None = None
+                       ) -> MDSDaemon:
         admin = await self.client()
         await admin.pool_create("cephfs_metadata", pg_num=8, size=3)
-        await admin.pool_create("cephfs_data", pg_num=8, size=3)
+        await admin.pool_create("cephfs_data",
+                                **(data_pool_opts
+                                   or {"pg_num": 8, "size": 3}))
         self.mds = MDSDaemon(self.mon_addrs)
         # small stripes so tests cross object boundaries cheaply
         self.mds.stripe_unit = 4096
@@ -247,6 +250,53 @@ def test_two_mounts_see_each_other(tmp_path):
             await fs2.rename("/shared/note", "/shared/note2")
             assert not await fs1.exists("/shared/note")
             assert await fs1.read_file("/shared/note2") == b"from fs1"
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_cephfs_on_ec_data_pool(tmp_path):
+    """File data in an erasure-coded pool, metadata replicated — the
+    reference's `fs add_data_pool` EC layout. Striped file I/O,
+    overwrite (EC RMW), truncate-via-rewrite, and unlink purge all ride
+    EC data objects."""
+    async def body():
+        c = FSHarness(tmp_path, n_osds=4)
+        try:
+            await c.start()
+            admin = await c.client()
+            await admin.command({"prefix": "osd erasure-code-profile set",
+                                 "name": "fsec",
+                                 "profile": {"plugin": "jerasure",
+                                             "k": "2", "m": "2"}})
+            await c.start_fs(data_pool_opts={
+                "pg_num": 4, "pool_type": "erasure",
+                "erasure_code_profile": "fsec"})
+            fs = await c.mount()
+
+            await fs.mkdir("/d")
+            payload = bytes(range(256)) * 60        # crosses stripes
+            await fs.write_file("/d/file", payload)
+            assert await fs.read_file("/d/file") == payload
+
+            fh = await fs.open("/d/file", "a")
+            await fh.write(b"MID", offset=5000)     # EC RMW overwrite
+            await fh.close()
+            got = await fs.read_file("/d/file")
+            assert got[5000:5003] == b"MID"
+            assert got[:5000] == payload[:5000]
+            assert got[5003:] == payload[5003:]
+
+            # data objects live in the EC pool
+            data = fs.rados.ioctx("cephfs_data")
+            assert await data.list_objects(), "no EC data objects"
+
+            await fs.unlink("/d/file")
+            deadline = asyncio.get_running_loop().time() + 10
+            while await data.list_objects():
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError("unlink never purged EC data")
+                await asyncio.sleep(0.2)
         finally:
             await c.stop()
     run(body())

@@ -254,3 +254,69 @@ def test_suffix_range_get(tmp_path):
         finally:
             await c.stop()
     run(body())
+
+
+def test_s3_objects_on_ec_data_pool(tmp_path):
+    """Reference zone-placement split: bucket indexes (omap) in the
+    replicated pool, object data in an erasure-coded pool — PUT/GET/
+    ranged GET/multipart/DELETE all ride EC data objects."""
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=4)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rgw", pg_num=4, size=3)
+            await cl.command({"prefix": "osd erasure-code-profile set",
+                              "name": "rgwec",
+                              "profile": {"plugin": "jerasure", "k": "2",
+                                          "m": "2"}})
+            await cl.pool_create("rgwdata", pg_num=4,
+                                 pool_type="erasure",
+                                 erasure_code_profile="rgwec")
+            gw = RGWGateway(cl.ioctx("rgw"),
+                            data_ioctx=cl.ioctx("rgwdata"))
+            addr = await gw.start()
+            try:
+                assert (await asyncio.to_thread(
+                    _req, addr, "PUT", "/b"))[0] == 200
+                blob = bytes(range(256)) * 80       # 20480 B
+                assert (await asyncio.to_thread(
+                    _req, addr, "PUT", "/b/obj", blob))[0] == 200
+                # the data object landed in the EC pool, not the index
+                assert "b/obj" in await cl.ioctx(
+                    "rgwdata").list_objects()
+                assert "b/obj" not in await cl.ioctx(
+                    "rgw").list_objects()
+                st, _, got = await asyncio.to_thread(
+                    _req, addr, "GET", "/b/obj")
+                assert st == 200 and got == blob
+                st, hdrs, got = await asyncio.to_thread(
+                    _ranged_req, addr, "/b/obj", "bytes=100-199")
+                assert st == 206 and got == blob[100:200]
+                # multipart rides EC parts
+                st, _, out = await asyncio.to_thread(
+                    _req, addr, "POST", "/b/mp?uploads")
+                assert st == 200
+                upload_id = out.split(b"<UploadId>")[1].split(
+                    b"</UploadId>")[0].decode()
+                for n, piece in ((1, b"A" * 9000), (2, b"B" * 5000)):
+                    st, _, _2 = await asyncio.to_thread(
+                        _req, addr, "PUT",
+                        f"/b/mp?uploadId={upload_id}&partNumber={n}",
+                        piece)
+                    assert st == 200
+                st, _, _2 = await asyncio.to_thread(
+                    _req, addr, "POST", f"/b/mp?uploadId={upload_id}")
+                assert st == 200
+                st, _, got = await asyncio.to_thread(
+                    _req, addr, "GET", "/b/mp")
+                assert st == 200 and got == b"A" * 9000 + b"B" * 5000
+                assert (await asyncio.to_thread(
+                    _req, addr, "DELETE", "/b/obj"))[0] == 204
+                assert (await asyncio.to_thread(
+                    _req, addr, "GET", "/b/obj"))[0] == 404
+            finally:
+                await gw.stop()
+        finally:
+            await c.stop()
+    run(body())
