@@ -32,6 +32,9 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+from ceph_tpu.utils import tracer  # noqa: E402
 TOTAL_BUDGET = int(os.environ.get("CEPH_TPU_BENCH_TIMEOUT", "2400"))
 CPU_TIMEOUT = 420
 DEVICE_TIMEOUT = 900  # single long warm: backend init + benches, one child
@@ -60,6 +63,15 @@ def _tpu_env() -> dict:
 
 def run_stage(stage: str, env: dict, timeout: float) -> dict:
     """Run one bench_driver stage; returns {"status", "elapsed_s", ...data}."""
+    with tracer.span(f"bench:{stage}") as sp:
+        out = _run_stage_child(stage, env, timeout)
+        if sp is not None:
+            sp.set_tag("status", out.get("status"))
+            sp.set_tag("platform", out.get("platform"))
+        return out
+
+
+def _run_stage_child(stage: str, env: dict, timeout: float) -> dict:
     t0 = time.monotonic()
     try:
         proc = subprocess.run(
@@ -95,6 +107,9 @@ def run_stage(stage: str, env: dict, timeout: float) -> dict:
 
 def main() -> int:
     stages: dict[str, object] = {}
+    # per-stage spans: the breakdown rides the output JSON as `trace`
+    # and prints alongside the GB/s lines
+    tracer.enable()
 
     # Stage 1: CPU baselines — hermetic, hang-proof by construction.
     cpu = run_stage("cpu", _hermetic_env(), _budget(CPU_TIMEOUT))
@@ -152,6 +167,17 @@ def main() -> int:
         out["error"] = ("tpu backend did not come up inside the "
                         f"{DEVICE_TIMEOUT}s long-warm device child; device "
                         "numbers are the hermetic cpu-jax fallback")
+    # per-stage wall-clock breakdown from the stage spans
+    spans = [s for s in tracer.collector().spans()
+             if s["name"].startswith("bench:")]
+    out["trace"] = [{"stage": s["name"][len("bench:"):],
+                     "seconds": round(s["duration_us"] / 1e6, 1),
+                     "status": s["tags"].get("status"),
+                     "platform": s["tags"].get("platform")}
+                    for s in spans]
+    sys.stderr.write("stage breakdown: " + " | ".join(
+        f"{t['stage']} {t['seconds']}s ({t['status']})"
+        for t in out["trace"]) + "\n")
     print(json.dumps(out), flush=True)
     return 0
 

@@ -40,6 +40,7 @@ from ceph_tpu.ec.plugin_jerasure import ErasureCodeJerasure
 from ceph_tpu.ec.registry import (ERASURE_CODE_VERSION, ErasureCodePlugin,
                                   ErasureCodePluginRegistry)
 from ceph_tpu.ops import rs_codec
+from ceph_tpu.utils import tracer
 
 __erasure_code_version__ = ERASURE_CODE_VERSION
 
@@ -73,10 +74,23 @@ class ErasureCodeTpu(ErasureCodeJerasure):
 
     def encode_stripes(self, data: np.ndarray | jax.Array) -> np.ndarray | jax.Array:
         """(batch, k, S) -> (batch, m, S) parity. numpy in => pipelined
-        host transfer + numpy out; device array in => device array out."""
-        if isinstance(data, jax.Array):
-            return self._encoder.apply_batch_device(data)
-        return self._encode_host_pipelined(np.ascontiguousarray(data, dtype=np.uint8))
+        host transfer + numpy out; device array in => device array out.
+        Each call is one traced device dispatch: the span separates
+        device-resident time from host-buffer (H2D + compute + D2H)
+        time, per stripe batch."""
+        device_resident = isinstance(data, jax.Array)
+        with tracer.span("tpu_encode_dispatch") as sp:
+            if sp is not None:
+                sp.set_tag("mode", "device" if device_resident
+                           else "host-pipelined")
+                sp.set_tag("batch", int(data.shape[0]))
+                sp.set_tag("bytes", int(data.size))
+                sp.set_tag("k", self.k)
+                sp.set_tag("m", self.m)
+            if device_resident:
+                return self._encoder.apply_batch_device(data)
+            return self._encode_host_pipelined(
+                np.ascontiguousarray(data, dtype=np.uint8))
 
     def _encode_host_pipelined(self, data: np.ndarray) -> np.ndarray:
         b = data.shape[0]
@@ -99,11 +113,18 @@ class ErasureCodeTpu(ErasureCodeJerasure):
         reconstructed `want_ids` chunks as (batch, len(want), S)."""
         R = rs_codec.recovery_matrix(self.coding_matrix, avail_ids, want_ids)
         codec = rs_codec.MatrixCodec.get(R)
-        if isinstance(chunks, jax.Array):
-            return codec.apply_batch_device(chunks)
-        chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
-        dev = jnp.asarray(chunks)
-        return np.asarray(codec.apply_batch_device(dev))
+        device_resident = isinstance(chunks, jax.Array)
+        with tracer.span("tpu_decode_dispatch") as sp:
+            if sp is not None:
+                sp.set_tag("mode", "device" if device_resident else "host")
+                sp.set_tag("batch", int(chunks.shape[0]))
+                sp.set_tag("bytes", int(chunks.size))
+                sp.set_tag("want", list(want_ids))
+            if device_resident:
+                return codec.apply_batch_device(chunks)
+            chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+            dev = jnp.asarray(chunks)
+            return np.asarray(codec.apply_batch_device(dev))
 
 
 class ErasureCodePluginTpu(ErasureCodePlugin):

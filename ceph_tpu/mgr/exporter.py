@@ -16,6 +16,7 @@ import asyncio
 import json
 from typing import Awaitable, Callable
 
+from ceph_tpu.utils import tracer
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import PerfCountersCollection
 
@@ -41,11 +42,27 @@ def render_metrics(health: dict | None = None) -> str:
                                   ("_count", value["avgcount"])):
                     out.append(f"{metric}{suffix}{{{label}}} {v}")
                 continue
-            if isinstance(value, dict):        # histogram: export buckets
-                for bucket, count in value.get("buckets", {}).items():
-                    out.append(
-                        f'{metric}_bucket{{{label},le="{bucket}"}} '
-                        f"{count}")
+            if isinstance(value, dict):
+                # TYPE_HISTOGRAM: proper cumulative prometheus histogram
+                # series. Internal bucket i counts values in
+                # [2^i, 2^(i+1)), so `le` is the numeric upper bound
+                # 2^(i+1) in the counter's recorded unit (*_us = µs)
+                if metric not in seen_types:
+                    out.append(f"# TYPE {metric} histogram")
+                    seen_types.add(metric)
+                counts = {int(b[2:]): n
+                          for b, n in value.get("buckets", {}).items()}
+                cum = 0
+                for exp in sorted(counts):
+                    cum += counts[exp]
+                    out.append(f'{metric}_bucket{{{label},'
+                               f'le="{2 ** (exp + 1)}"}} {cum}')
+                out.append(f'{metric}_bucket{{{label},le="+Inf"}} '
+                           f"{value.get('count', cum)}")
+                out.append(f"{metric}_sum{{{label}}} "
+                           f"{value.get('sum', 0.0)}")
+                out.append(f"{metric}_count{{{label}}} "
+                           f"{value.get('count', cum)}")
                 continue
             if metric not in seen_types:
                 out.append(f"# TYPE {metric} counter")
@@ -84,6 +101,21 @@ def render_dashboard(status: dict, health: dict | None) -> str:
                       f"{esc(str(chk.get('summary')))}</li>")
     om = status.get("osdmap") or {}
     mods = esc(json.dumps(status.get("modules", {}), indent=1))
+    # recent traces (process-wide span collector; empty when tracing off)
+    trace_rows = []
+    for t in tracer.recent_traces(limit=15):
+        trace_rows.append(
+            f"<tr><td>{esc(t['trace_id'])}</td>"
+            f"<td>{esc(str(t['root']))}</td>"
+            f"<td>{esc(', '.join(t['services']))}</td>"
+            f"<td>{t['num_spans']}</td>"
+            f"<td>{t['duration_us'] / 1000:.2f}</td></tr>")
+    traces_html = ("<h2>recent traces</h2><table><tr><th>trace</th>"
+                   "<th>root</th><th>services</th><th>spans</th>"
+                   "<th>ms</th></tr>" + "".join(trace_rows) + "</table>"
+                   if trace_rows else
+                   "<h2>recent traces</h2><p>tracing off or no spans "
+                   "collected (config set tracer_enabled true)</p>")
     return f"""<!doctype html><html><head><title>ceph-tpu dashboard</title>
 <style>body{{font-family:monospace;margin:2em}}
 table{{border-collapse:collapse}}td,th{{border:1px solid #ccc;
@@ -98,6 +130,7 @@ mons {', '.join(str(q) for q in
 <h2>pools</h2>
 <table><tr><th>pool</th><th>type</th><th>size</th><th>pg_num</th></tr>
 {''.join(rows)}</table>
+{traces_html}
 <h2>mgr modules</h2><pre>{mods}</pre>
 <p><a href="/metrics">metrics</a> &middot;
 <a href="/status.json">status.json</a></p></body></html>"""

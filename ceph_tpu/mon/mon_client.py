@@ -17,9 +17,10 @@ from __future__ import annotations
 import asyncio
 import time
 
-from ceph_tpu.msg.messages import (Message, MMonCommand, MMonCommandAck,
-                                   MMonGetMap, MMonMap, MMonSubscribe,
-                                   MOSDBoot, MOSDFailure, MOSDMapMsg)
+from ceph_tpu.msg.messages import (MLog, Message, MMonCommand,
+                                   MMonCommandAck, MMonGetMap, MMonMap,
+                                   MMonSubscribe, MOSDBoot, MOSDFailure,
+                                   MOSDMapMsg)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
 from ceph_tpu.utils.dout import dout
 
@@ -158,6 +159,18 @@ class MonClient(Dispatcher):
     async def report_failure(self, failed: int, reporter: int) -> None:
         conn = await self._ensure_conn()
         conn.send_message(MOSDFailure({"failed": failed, "from": reporter}))
+
+    _LOG_LEVELS = ("WRN", "ERR")
+
+    async def send_log(self, level: str, who: str, message: str) -> None:
+        """Ship one cluster-log line to the mon (LogClient-lite). Only
+        WARN+ levels travel — the channel is for health events, not
+        debug chatter (mon_cluster_log_level analog)."""
+        if level not in self._LOG_LEVELS:
+            return
+        conn = await self._ensure_conn()
+        conn.send_message(MLog({"level": level, "who": who,
+                                "message": message, "stamp": time.time()}))
 
     async def close(self) -> None:
         self._closed = True

@@ -25,16 +25,18 @@ this simpler without changing observable behavior).
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import time
 
 from ceph_tpu.crush import CrushMap, Incremental, OSDMap, Pool, Rule, Step
 from ceph_tpu.mon.paxos import NotLeader, Paxos
 from ceph_tpu.mon.store import MonStore, MonStoreTxn
-from ceph_tpu.msg.messages import (Message, MMonCommand, MMonCommandAck,
-                                   MMonElection, MMonGetMap, MMonMap,
-                                   MMonPaxos, MMonSubscribe, MOSDBoot,
-                                   MOSDFailure, MOSDMapMsg, MPing, MPingReply)
+from ceph_tpu.msg.messages import (MLog, Message, MMonCommand,
+                                   MMonCommandAck, MMonElection,
+                                   MMonGetMap, MMonMap, MMonPaxos,
+                                   MMonSubscribe, MOSDBoot, MOSDFailure,
+                                   MOSDMapMsg, MPing, MPingReply)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
 from ceph_tpu.utils.dout import dout
 
@@ -329,6 +331,10 @@ class OSDMonitor:
             pending = self.get_pending()
             if failed not in pending.new_down:
                 pending.new_down.append(failed)
+                self.mon.clog(
+                    "WRN", f"mon.{self.mon.name}",
+                    f"osd.{failed} marked down "
+                    f"({len(reporters)} reporters: {sorted(reporters)})")
             return True
         return False
 
@@ -371,6 +377,11 @@ class Monitor(Dispatcher):
         self.subs: dict[Connection, int] = {}
         self._tick_task: asyncio.Task | None = None
         self._applied = 0      # last paxos version applied to services
+        # cluster log (LogMonitor-lite, src/mon/LogMonitor.cc): WARN+
+        # events from daemons (MLog) and this mon's own map-change
+        # events, in a bounded ring queryable via `log last`
+        self.cluster_log: collections.deque[dict] = \
+            collections.deque(maxlen=1000)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -487,9 +498,24 @@ class Monitor(Dispatcher):
             await self._osd_plane(msg, self.osdmon.handle_boot)
         elif isinstance(msg, MOSDFailure):
             await self._osd_plane(msg, self.osdmon.handle_failure)
+        elif isinstance(msg, MLog):
+            p = msg.payload
+            self.clog(p.get("level", "WRN"), p.get("who", "?"),
+                      p.get("message", ""), stamp=p.get("stamp"))
         else:
             return False
         return True
+
+    # -- cluster log ---------------------------------------------------------
+
+    def clog(self, level: str, who: str, message: str,
+             stamp: float | None = None) -> None:
+        """Append one cluster-log line (whichever mon a daemon's session
+        lands on records it; `log last` reads that mon's ring)."""
+        self.cluster_log.append(
+            {"stamp": stamp if stamp is not None else time.time(),
+             "level": level, "who": who, "message": message})
+        dout("mon", 2, f"mon.{self.name} clog [{level}] {who}: {message}")
 
     def ms_handle_reset(self, conn: Connection) -> None:
         self.subs.pop(conn, None)
@@ -561,7 +587,7 @@ class Monitor(Dispatcher):
         read_only = prefix in ("mon stat", "osd dump", "osd tree",
                                "osd erasure-code-profile ls",
                                "osd erasure-code-profile get",
-                               "status", "health")
+                               "status", "health", "log last")
         if not read_only and not (self.paxos.is_leader()
                                   and self.paxos.is_active()):
             conn.send_message(self._retry_ack(tid, "not leader"))
@@ -654,6 +680,13 @@ class Monitor(Dispatcher):
                                    "pg_num": p.pg_num}
                           for p in om.osdmap.pools.values()},
             }
+        if prefix == "log last":
+            n = int(cmd.get("num", 20))
+            lines = list(self.cluster_log)
+            level = cmd.get("level")
+            if level:
+                lines = [e for e in lines if e["level"] == level]
+            return {"lines": lines[-n:] if n > 0 else []}
         if prefix == "mon stat":
             return {"name": self.name, "rank": self.rank,
                     "leader": self.paxos.leader,

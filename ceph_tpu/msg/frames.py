@@ -38,6 +38,30 @@ MAX_SEGMENTS = 4
 _PRE_FIXED = struct.Struct("<HBB")
 _U32 = struct.Struct("<I")
 
+# trace-context TLV segment (the Message.h otel_trace analog): an
+# OPTIONAL trailing frame segment `magic u16 | trace_id u64 | span_id
+# u64` stamped on MESSAGE frames when tracing is on. Peers that predate
+# it never send it, and receivers that don't know the magic drop it —
+# the op itself is untouched either way.
+TRACE_MAGIC = 0xEC7C
+_TRACE_SEG = struct.Struct("<HQQ")
+
+
+def encode_trace_ctx(ctx: dict) -> bytes:
+    """Pack a tracer wire context ({"t": trace_id, "s": span_id})."""
+    return _TRACE_SEG.pack(TRACE_MAGIC, ctx["t"], ctx["s"])
+
+
+def decode_trace_ctx(seg: bytes) -> dict | None:
+    """Unpack a trace segment; None when it isn't one (unknown magic or
+    wrong size — forward/backward compatible by construction)."""
+    if len(seg) != _TRACE_SEG.size:
+        return None
+    magic, trace_id, span_id = _TRACE_SEG.unpack(seg)
+    if magic != TRACE_MAGIC:
+        return None
+    return {"t": trace_id, "s": span_id}
+
 
 def crc32c(data: bytes, seed: int = 0) -> int:
     return ec_native.crc32c(data, seed)

@@ -40,6 +40,9 @@ class Message:
         self.data = data
         # transport fields, stamped by the Connection
         self.seq = 0
+        # optional trace context ({"t","s"}), stamped at send time when
+        # tracing is on; rides a trailing TLV segment (frames.TRACE_MAGIC)
+        self.trace: dict | None = None
 
     # -- wire form -----------------------------------------------------------
 
@@ -48,11 +51,15 @@ class Message:
                             separators=(",", ":")).encode()
         payload = json.dumps(self.payload, separators=(",", ":"),
                              sort_keys=True).encode()
-        return [header, payload, self.data]
+        segments = [header, payload, self.data]
+        if self.trace is not None:
+            from ceph_tpu.msg.frames import encode_trace_ctx
+            segments.append(encode_trace_ctx(self.trace))
+        return segments
 
     @staticmethod
     def decode_segments(segments: list[bytes]) -> "Message":
-        if len(segments) != 3:
+        if len(segments) not in (3, 4):
             raise ValueError(f"message frame has {len(segments)} segments")
         header = json.loads(segments[0])
         cls = _REGISTRY.get(header["type"])
@@ -61,6 +68,11 @@ class Message:
         msg = cls.__new__(cls)
         Message.__init__(msg, json.loads(segments[1]), segments[2])
         msg.seq = header["seq"]
+        if len(segments) == 4:
+            # unknown trailing segments are dropped, not errors: a newer
+            # peer's extra TLV must never break this one
+            from ceph_tpu.msg.frames import decode_trace_ctx
+            msg.trace = decode_trace_ctx(segments[3])
         return msg
 
     def __repr__(self) -> str:
@@ -89,6 +101,10 @@ MOSDMapMsg = _simple(0x22, "MOSDMapMsg")          # {"full": {...}|null,
 MMonSubscribe = _simple(0x23, "MMonSubscribe")    # {"what": {"osdmap": start}}
 MMonCommand = _simple(0x24, "MMonCommand")        # {"cmd": {...}, "tid": n}
 MMonCommandAck = _simple(0x25, "MMonCommandAck")  # {"tid", "rc", "out": {...}}
+MLog = _simple(0x28, "MLog")                      # daemon -> mon cluster-log
+                                                  # entry (MLog.h): {"level":
+                                                  #  "WRN"|"ERR", "who",
+                                                  #  "message", "stamp"}
 
 # -- mon<->mon quorum plane (MMonElection.h, MMonPaxos.h) --------------------
 MMonElection = _simple(0x26, "MMonElection")      # {"op": propose|ack|victory,

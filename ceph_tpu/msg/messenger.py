@@ -35,6 +35,7 @@ from typing import Awaitable, Callable
 
 from ceph_tpu.msg.frames import BANNER, Frame, FrameError, Tag, Onwire
 from ceph_tpu.msg.messages import Message
+from ceph_tpu.utils import tracer
 from ceph_tpu.utils.dout import dout
 
 
@@ -153,6 +154,17 @@ class Connection:
         down transport (lossless replays, lossy drops on reset)."""
         if self._closed:
             return
+        if msg.trace is None and tracer.current_context() is not None:
+            # sending-end messenger span: the moment the message entered
+            # the transport, as a child of whatever op is running; its
+            # OWN id rides the wire so the receiving end nests under it
+            sp = tracer.start_span("ms_send", self.messenger.entity_name)
+            if sp is not None:
+                sp.set_tag("type", type(msg).__name__)
+                sp.set_tag("peer", self.peer_name or str(self.peer_addr))
+                sp.set_tag("bytes", len(msg.data))
+                msg.trace = sp.context()
+                sp.finish()
         self.out_seq += 1
         msg.seq = self.out_seq
         if not self.policy.lossy:
@@ -325,11 +337,25 @@ class Connection:
         die (dispatcher reset callback), lossless initiators reconnect
         with backoff, lossless acceptors park until the peer's RECONNECT
         re-attaches a transport."""
-        self._spawn(self._dispatch_loop())
+        dispatch = asyncio.get_running_loop().create_task(
+            self._dispatch_loop())
+        self._tasks.add(dispatch)
+        dispatch.add_done_callback(self._tasks.discard)
         try:
             await self._run_inner()
         finally:
             self.messenger._forget(self)
+            # the session is over (closed / lossy reset / park timeout):
+            # reap the dispatch task HERE — by now the conn is out of
+            # every messenger table, so shutdown() can no longer reach
+            # it and an unreaped task leaks ("Task was destroyed but it
+            # is pending!" at loop teardown, seen in BENCH_r05)
+            if not dispatch.done():
+                dispatch.cancel()
+                try:
+                    await dispatch
+                except (asyncio.CancelledError, Exception):
+                    pass
 
     async def _run_inner(self) -> None:
         backoff = self.RECONNECT_BACKOFF
@@ -438,7 +464,20 @@ class Connection:
         while not self._closed:
             gen, msg = await self._dispatch_q.get()
             try:
-                await self.messenger._dispatch(self, msg)
+                if msg.trace is not None and tracer.enabled():
+                    # receiving-end messenger span: covers the handler,
+                    # nested under the sender's ms_send so the trace
+                    # stays connected across the socket; handlers' own
+                    # spans (PG, EC, store) nest under this context
+                    with tracer.span("ms_dispatch",
+                                     self.messenger.entity_name,
+                                     parent=msg.trace) as sp:
+                        if sp is not None:
+                            sp.set_tag("type", type(msg).__name__)
+                            sp.set_tag("bytes", len(msg.data))
+                        await self.messenger._dispatch(self, msg)
+                else:
+                    await self.messenger._dispatch(self, msg)
             except asyncio.CancelledError:
                 raise
             except Exception as e:

@@ -10,9 +10,12 @@ same API later.
 from __future__ import annotations
 
 import enum
+import functools
+import time
 from typing import Callable, Iterable, Mapping
 
 from ceph_tpu.objectstore.types import CollectionId, Ghobject
+from ceph_tpu.utils import tracer
 
 NO_SHARD = -1
 
@@ -152,8 +155,48 @@ class Transaction:
         return self
 
 
+def _observed_txn(fn):
+    """Wrap a backend's queue_transaction with commit observability: a
+    `store_commit` trace span (the objectstore stage of an op's trace)
+    and, when the hosting daemon attached a histogram sink
+    (`store.commit_perf`), a `store_commit_us` latency sample. Both
+    gates are plain attribute/flag reads — the undecorated fast path
+    runs when neither is on."""
+    @functools.wraps(fn)
+    def queue_transaction(self, txn):
+        perf = self.commit_perf
+        if perf is None and not tracer.enabled():
+            return fn(self, txn)
+        t0 = time.perf_counter()
+        try:
+            with tracer.span("store_commit",
+                             getattr(self, "name", type(self).__name__)
+                             ) as sp:
+                if sp is not None:
+                    sp.set_tag("ops", len(txn))
+                return fn(self, txn)
+        finally:
+            if perf is not None:
+                perf.hist_add("store_commit_us",
+                              (time.perf_counter() - t0) * 1e6)
+    queue_transaction._observed = True
+    return queue_transaction
+
+
 class ObjectStore:
     """Abstract store API (ObjectStore.h)."""
+
+    #: optional PerfCounters holding a `store_commit_us` histogram; the
+    #: hosting daemon points this at its own registered counters
+    commit_perf = None
+
+    def __init_subclass__(cls, **kwargs):
+        # every concrete backend's queue_transaction picks up the commit
+        # span + histogram without each backend re-implementing it
+        super().__init_subclass__(**kwargs)
+        impl = cls.__dict__.get("queue_transaction")
+        if impl is not None and not getattr(impl, "_observed", False):
+            cls.queue_transaction = _observed_txn(impl)
 
     # lifecycle
     def mkfs(self) -> None:

@@ -32,10 +32,12 @@ from ceph_tpu.objectstore.memstore import MemStore
 from ceph_tpu.objectstore.store import StoreError
 from ceph_tpu.osd.backend import IntervalChange
 from ceph_tpu.osd.pg import PGInstance
+from ceph_tpu.utils import tracer
 from ceph_tpu.utils.admin_socket import AdminSocket
 from ceph_tpu.utils.config import Config, Option
 from ceph_tpu.utils.dout import dout
-from ceph_tpu.utils.perf_counters import (TYPE_AVG, PerfCountersCollection)
+from ceph_tpu.utils.perf_counters import (TYPE_AVG, TYPE_HISTOGRAM,
+                                          PerfCountersCollection)
 from ceph_tpu.utils.throttle import HeartbeatMap
 from ceph_tpu.utils.work_queue import (Finisher, OpTracker, ShardedOpQueue,
                                        reset_current_op, set_current_op)
@@ -84,6 +86,9 @@ class OSD(Dispatcher):
                    "host-wide recovery reservation slots (startup only)",
                    minimum=1),
         ])
+        # op tracing rides the same config (hot-togglable: `config set
+        # tracer_enabled true` over the admin socket starts collecting)
+        tracer.register_config(self.config)
         # per-daemon perf counters, served by `perf dump` (the admin
         # socket reads the process-wide collection)
         coll = PerfCountersCollection.instance()
@@ -97,6 +102,19 @@ class OSD(Dispatcher):
                       description="objects pushed by recovery/backfill")
         self.perf.add("heartbeat_failures",
                       description="peers reported failed to the mon")
+        # per-stage latency histograms (power-of-two µs buckets; the
+        # exporter renders them as cumulative prometheus histograms)
+        self.perf.add("op_total_us", type=TYPE_HISTOGRAM,
+                      description="client op total latency (µs)")
+        self.perf.add("op_queue_wait_us", type=TYPE_HISTOGRAM,
+                      description="op queue wait before dequeue (µs)")
+        self.perf.add("ec_encode_us", type=TYPE_HISTOGRAM,
+                      description="EC encode dispatch latency (µs)")
+        self.perf.add("store_commit_us", type=TYPE_HISTOGRAM,
+                      description="objectstore queue_transaction "
+                                  "latency (µs)")
+        # the store feeds its commit latency into this daemon's histogram
+        self.store.commit_perf = self.perf
         # op execution substrate: sharded queue (per-PG order, cross-PG
         # concurrency) + finisher for completions + per-op tracking
         self.hb_map = HeartbeatMap()
@@ -333,6 +351,12 @@ class OSD(Dispatcher):
                                f"re-booting")
                 self._reboot_task = asyncio.get_running_loop().create_task(
                     self._reboot_until_up())
+                t = asyncio.get_running_loop().create_task(
+                    self.monc.send_log(
+                        "WRN", f"osd.{self.whoami}",
+                        "map wrongly marked me down; re-booting"))
+                self._bg_tasks.add(t)
+                t.add_done_callback(self._bg_task_done)
         for peer in list(self._conns):
             if not self.osdmap.is_up(peer):
                 self._drop_conn(peer)
@@ -436,6 +460,17 @@ class OSD(Dispatcher):
                                            f"osd.{peer} down")
                         except Exception:
                             self._hb_reported.discard(peer)
+                        else:
+                            # best-effort: a failed clog line must not
+                            # un-record the (delivered) failure report
+                            try:
+                                await self.monc.send_log(
+                                    "WRN", f"osd.{self.whoami}",
+                                    f"no heartbeat reply from osd.{peer} "
+                                    f"for {now - last:.1f}s; reported "
+                                    f"failed")
+                            except Exception:
+                                pass
                     continue
                 try:
                     await self.send_osd(peer, MPing(
@@ -582,24 +617,34 @@ class OSD(Dispatcher):
             # the reference routes notifies outside the write pipeline.
             # Still tracked + counted like any other op.
             trk = self.optracker.create(desc)
+            trk.trace = tracer.current_context()
             trk.mark_event("detached_notify")
 
             async def run_notify():
                 token = set_current_op(trk)
                 t0 = time.monotonic()
                 try:
-                    await self._handle_op(conn, msg)
+                    with tracer.span("osd_op", f"osd.{self.whoami}",
+                                     parent=trk.trace) as sp:
+                        if sp is not None:
+                            sp.set_tag("desc", trk.description)
+                        await self._handle_op(conn, msg)
                 finally:
                     reset_current_op(token)
                     trk.finish()
                     self.perf.inc("op")
-                    self.perf.avg_add("op_latency",
-                                      time.monotonic() - t0)
+                    lat = time.monotonic() - t0
+                    self.perf.avg_add("op_latency", lat)
+                    self.perf.hist_add("op_total_us", lat * 1e6)
             t = asyncio.get_running_loop().create_task(run_notify())
             self._notify_tasks.add(t)
             t.add_done_callback(self._notify_tasks.discard)
             return
         trk = self.optracker.create(desc)
+        # the trace context (the connection's ms_dispatch span) rides the
+        # TrackedOp: the queued closure runs in a shard worker task where
+        # the dispatch context is gone
+        trk.trace = tracer.current_context()
         trk.mark_event("queued")
         self._op_seq += 1
         seq = self._op_seq
@@ -616,6 +661,8 @@ class OSD(Dispatcher):
 
     def _enqueue_op(self, pgid: PG, seq: int, conn: Connection,
                     msg: MOSDOp, trk) -> None:
+        t_enq = time.monotonic()
+
         async def work():
             # the PG may have left 'active' while this op sat in the
             # queue: re-park instead of wedging the shard worker on a
@@ -625,15 +672,25 @@ class OSD(Dispatcher):
                 self._park_op(pgid, seq, conn, msg, trk)
                 return
             trk.mark_event("dequeued")
+            self.perf.hist_add("op_queue_wait_us",
+                               (time.monotonic() - t_enq) * 1e6)
             token = set_current_op(trk)
             t0 = time.monotonic()
             try:
-                await self._handle_op(conn, msg)
+                with tracer.span("osd_op", f"osd.{self.whoami}",
+                                 parent=trk.trace) as sp:
+                    if sp is not None:
+                        sp.set_tag("desc", trk.description)
+                        sp.set_tag("queue_wait_us",
+                                   round((t0 - t_enq) * 1e6, 1))
+                    await self._handle_op(conn, msg)
             finally:
                 reset_current_op(token)
                 trk.finish()
                 self.perf.inc("op")
-                self.perf.avg_add("op_latency", time.monotonic() - t0)
+                lat = time.monotonic() - t0
+                self.perf.avg_add("op_latency", lat)
+                self.perf.hist_add("op_total_us", lat * 1e6)
         self.op_queue.enqueue((pgid.pool, pgid.ps), work)
 
     def requeue_waiting(self, pg: PGInstance) -> None:

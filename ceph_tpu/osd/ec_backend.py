@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
 
 from ceph_tpu.crush.crush import CRUSH_NONE
 from ceph_tpu.ec import registry
@@ -44,6 +45,7 @@ from ceph_tpu.objectstore.store import StoreError
 from ceph_tpu.osd import ec_util
 from ceph_tpu.osd.backend import (SUBOP_TIMEOUT, IntervalChange, PGBackend)
 from ceph_tpu.osd.pglog import LogEntry
+from ceph_tpu.utils import tracer
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.work_queue import mark_op_event
 
@@ -97,6 +99,16 @@ class ECBackend(PGBackend):
     def _pad(self, data: bytes) -> bytes:
         w = self.sinfo.stripe_width
         return data + b"\x00" * ((-len(data)) % w)
+
+    def _encode(self, data: bytes) -> dict[int, bytes]:
+        """One batched encode dispatch, sampled into the daemon's
+        `ec_encode_us` histogram (ec_util opens the per-dispatch span
+        with bytes/k/m tags)."""
+        t0 = time.perf_counter()
+        shards = ec_util.encode(self.sinfo, self.ec_impl, data)
+        self.host.perf.hist_add("ec_encode_us",
+                                (time.perf_counter() - t0) * 1e6)
+        return shards
 
     def _csums(self, shard_buf: bytes) -> list[int]:
         """Per-chunk crc32c list of a shard buffer (Checksummer analog).
@@ -172,7 +184,16 @@ class ECBackend(PGBackend):
         ent[1] += 1
         try:
             async with ent[0]:
-                await self._execute_write_locked(oid, op, data, entry, off)
+                with tracer.span("ec_write",
+                                 f"osd.{self.host.whoami}") as sp:
+                    if sp is not None:
+                        sp.set_tag("op", op)
+                        sp.set_tag("oid", oid)
+                        sp.set_tag("bytes", len(data))
+                        sp.set_tag("k", self.k)
+                        sp.set_tag("m", self.n - self.k)
+                    await self._execute_write_locked(oid, op, data,
+                                                     entry, off)
         finally:
             ent[1] -= 1
             if ent[1] == 0 and self._obj_locks.get(oid) is ent:
@@ -190,7 +211,7 @@ class ECBackend(PGBackend):
 
         if op in ("write_full", "push"):
             padded = self._pad(data)
-            shards = ec_util.encode(self.sinfo, self.ec_impl, padded) \
+            shards = self._encode(padded) \
                 if padded else {i: b"" for i in range(self.n)}
             # WRITEFULL replaces data, not xattrs: the full-state shard
             # rewrite must carry the user attrs forward (the primary's
@@ -358,7 +379,7 @@ class ECBackend(PGBackend):
         if tail < len(region):
             region[tail:] = b"\x00" * (len(region) - tail)
 
-        shards = ec_util.encode(self.sinfo, self.ec_impl, bytes(region))
+        shards = self._encode(bytes(region))
         new_n = -(-new_size // w)
         payloads = {}
         for i in live:

@@ -25,6 +25,7 @@ from typing import Iterable, Mapping
 import numpy as np
 
 from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.utils import tracer
 
 
 class StripeInfo:
@@ -132,30 +133,40 @@ def encode(sinfo: StripeInfo, ec_impl, data: bytes | np.ndarray,
     if n_stripes == 0:
         return {i: b"" for i in sorted(want)}
 
-    stripes = buf.reshape(n_stripes, k, sinfo.chunk_size)
     mapping = ec_impl.get_chunk_mapping()
-    if callable(getattr(ec_impl, "encode_stripes", None)) and not mapping:
-        parity = np.asarray(ec_impl.encode_stripes(stripes))
-        # shard-major contiguous copies first: .tobytes() on a strided
-        # view falls off numpy's memcpy path (~30x slower — profiled on
-        # the OSD write path)
-        dm = np.ascontiguousarray(stripes.transpose(1, 0, 2))   # (k, S, C)
-        pm = np.ascontiguousarray(parity.transpose(1, 0, 2))    # (m, S, C)
-        return {i: (dm[i] if i < k else pm[i - k]).tobytes()
-                for i in sorted(want)}
-    else:
-        data_pos = mapping if mapping else list(range(k))
-        out_chunks = []
-        for s in range(n_stripes):
-            chunks = {i: np.zeros(sinfo.chunk_size, dtype=np.uint8)
-                      for i in range(n_chunks)}
-            for rank, pos in enumerate(data_pos):
-                chunks[pos] = stripes[s, rank].copy()
-            ec_impl.encode_chunks(chunks)
-            out_chunks.append(np.stack([chunks[i] for i in range(n_chunks)]))
-        full = np.stack(out_chunks)
-    # shard i = chunks of all stripes, contiguous (S major)
-    return {i: full[:, i, :].tobytes() for i in sorted(want)}
+    batched = callable(getattr(ec_impl, "encode_stripes", None)) \
+        and not mapping
+    with tracer.span("ec_encode") as sp:
+        if sp is not None:
+            sp.set_tag("bytes", int(buf.size))
+            sp.set_tag("k", k)
+            sp.set_tag("m", n_chunks - k)
+            sp.set_tag("stripes", n_stripes)
+            sp.set_tag("batched", batched)
+        stripes = buf.reshape(n_stripes, k, sinfo.chunk_size)
+        if batched:
+            parity = np.asarray(ec_impl.encode_stripes(stripes))
+            # shard-major contiguous copies first: .tobytes() on a strided
+            # view falls off numpy's memcpy path (~30x slower — profiled on
+            # the OSD write path)
+            dm = np.ascontiguousarray(stripes.transpose(1, 0, 2))  # (k,S,C)
+            pm = np.ascontiguousarray(parity.transpose(1, 0, 2))   # (m,S,C)
+            return {i: (dm[i] if i < k else pm[i - k]).tobytes()
+                    for i in sorted(want)}
+        else:
+            data_pos = mapping if mapping else list(range(k))
+            out_chunks = []
+            for s in range(n_stripes):
+                chunks = {i: np.zeros(sinfo.chunk_size, dtype=np.uint8)
+                          for i in range(n_chunks)}
+                for rank, pos in enumerate(data_pos):
+                    chunks[pos] = stripes[s, rank].copy()
+                ec_impl.encode_chunks(chunks)
+                out_chunks.append(np.stack([chunks[i]
+                                            for i in range(n_chunks)]))
+            full = np.stack(out_chunks)
+        # shard i = chunks of all stripes, contiguous (S major)
+        return {i: full[:, i, :].tobytes() for i in sorted(want)}
 
 
 def _batched_reconstruct(ec_impl, stacked: Mapping[int, np.ndarray],
@@ -210,19 +221,29 @@ def decode_concat(sinfo: StripeInfo, ec_impl,
         for rank, cid in enumerate(want):
             out[:, rank, :] = stacked[cid]
         return out.tobytes()
-    if callable(getattr(ec_impl, "decode_stripes", None)) and not mapping:
-        recovered = _batched_reconstruct(ec_impl, stacked, avail_ids, missing)
-        out = np.empty((n_stripes, k, sinfo.chunk_size), dtype=np.uint8)
-        for rank, cid in enumerate(want):
-            out[:, rank, :] = stacked[cid] if cid in stacked else recovered[cid]
-        return out.tobytes()
+    with tracer.span("ec_decode") as sp:
+        if sp is not None:
+            sp.set_tag("bytes", int(total) * len(arrays))
+            sp.set_tag("k", k)
+            sp.set_tag("missing", missing)
+            sp.set_tag("stripes", n_stripes)
+        if callable(getattr(ec_impl, "decode_stripes", None)) \
+                and not mapping:
+            recovered = _batched_reconstruct(ec_impl, stacked, avail_ids,
+                                             missing)
+            out = np.empty((n_stripes, k, sinfo.chunk_size),
+                           dtype=np.uint8)
+            for rank, cid in enumerate(want):
+                out[:, rank, :] = stacked[cid] if cid in stacked \
+                    else recovered[cid]
+            return out.tobytes()
 
-    # per-stripe fallback through the scalar contract (reference loop)
-    parts = []
-    for s in range(n_stripes):
-        chunks = {i: stacked[i][s].tobytes() for i in avail_ids}
-        parts.append(ec_impl.decode_concat(chunks, sinfo.chunk_size))
-    return b"".join(parts)
+        # per-stripe fallback through the scalar contract (reference loop)
+        parts = []
+        for s in range(n_stripes):
+            chunks = {i: stacked[i][s].tobytes() for i in avail_ids}
+            parts.append(ec_impl.decode_concat(chunks, sinfo.chunk_size))
+        return b"".join(parts)
 
 
 def decode_shards(sinfo: StripeInfo, ec_impl, to_decode: Mapping[int, bytes],
@@ -268,31 +289,44 @@ def decode_shards(sinfo: StripeInfo, ec_impl, to_decode: Mapping[int, bytes],
         raise ErasureCodeError("shard buffer not aligned to repair unit")
     n_chunks = total // repair_per_chunk
 
-    if (sub == 1 and not ec_impl.get_chunk_mapping()
-            and callable(getattr(ec_impl, "decode_stripes", None))
-            and n_chunks > 0):
-        # whole-chunk repair on a batch-capable plugin: ONE device dispatch
-        # for all n_chunks repair units instead of a host round trip per
-        # chunk — the recovery path is the most bandwidth-hungry consumer
-        # (reference batching site: src/osd/ECUtil.cc:61-131)
-        stacked = {i: arrays[i].reshape(n_chunks, sinfo.chunk_size)
-                   for i in helpers}
-        recovered = _batched_reconstruct(ec_impl, stacked, helpers, need)
-        return {nid: np.ascontiguousarray(plane).tobytes()
-                for nid, plane in recovered.items()}
+    with tracer.span("ec_recover") as sp:
+        if sp is not None:
+            sp.set_tag("need", need)
+            sp.set_tag("helpers", helpers)
+            sp.set_tag("chunks", n_chunks)
+            # the sub-chunk repair plan (CLAY fetches fractions of each
+            # helper chunk; RS fetches whole chunks = sub_chunks)
+            sp.set_tag("sub_chunks", sub)
+            sp.set_tag("sub_chunks_fetched_per_chunk",
+                       next(iter(plan_counts.values())))
+        if (sub == 1 and not ec_impl.get_chunk_mapping()
+                and callable(getattr(ec_impl, "decode_stripes", None))
+                and n_chunks > 0):
+            # whole-chunk repair on a batch-capable plugin: ONE device
+            # dispatch for all n_chunks repair units instead of a host
+            # round trip per chunk — the recovery path is the most
+            # bandwidth-hungry consumer (reference batching site:
+            # src/osd/ECUtil.cc:61-131)
+            stacked = {i: arrays[i].reshape(n_chunks, sinfo.chunk_size)
+                       for i in helpers}
+            recovered = _batched_reconstruct(ec_impl, stacked, helpers,
+                                             need)
+            return {nid: np.ascontiguousarray(plane).tobytes()
+                    for nid, plane in recovered.items()}
 
-    outs = {i: [] for i in need}
-    for c in range(n_chunks):
-        chunks = {i: arrays[i][c * repair_per_chunk:
-                               (c + 1) * repair_per_chunk].tobytes()
-                  for i in helpers}
-        decoded = ec_impl.decode(need, chunks, sinfo.chunk_size)
-        for i in need:
-            if len(decoded[i]) != sinfo.chunk_size:
-                raise ErasureCodeError(
-                    f"decode returned {len(decoded[i])} bytes for shard {i}")
-            outs[i].append(decoded[i])
-    return {i: b"".join(parts) for i, parts in outs.items()}
+        outs = {i: [] for i in need}
+        for c in range(n_chunks):
+            chunks = {i: arrays[i][c * repair_per_chunk:
+                                   (c + 1) * repair_per_chunk].tobytes()
+                      for i in helpers}
+            decoded = ec_impl.decode(need, chunks, sinfo.chunk_size)
+            for i in need:
+                if len(decoded[i]) != sinfo.chunk_size:
+                    raise ErasureCodeError(
+                        f"decode returned {len(decoded[i])} bytes for "
+                        f"shard {i}")
+                outs[i].append(decoded[i])
+        return {i: b"".join(parts) for i, parts in outs.items()}
 
 
 # ---------------------------------------------------------------------------

@@ -34,6 +34,7 @@ from ceph_tpu.msg.messages import (Message, MOSDPGInfo, MOSDPGLog,
 from ceph_tpu.objectstore.store import StoreError, Transaction
 from ceph_tpu.objectstore.types import CollectionId, Ghobject
 from ceph_tpu.osd.pglog import ZERO, Eversion, LogEntry, PGLog
+from ceph_tpu.utils import tracer
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.work_queue import mark_op_event
 
@@ -828,7 +829,24 @@ class PGInstance:
     async def do_op(self, op: dict, data: bytes,
                     conn=None) -> tuple[int, dict, bytes]:
         """Execute one client op; returns (rc, out, outdata) — the
-        do_osd_ops dispatch table (src/osd/PrimaryLogPG.cc:5989)."""
+        do_osd_ops dispatch table (src/osd/PrimaryLogPG.cc:5989). Traced
+        as the `pg_op` stage of the op's trace (nested under the
+        daemon's osd_op span; the EC/store spans nest under this)."""
+        if not tracer.enabled():
+            return await self._do_op(op, data, conn)
+        with tracer.span("pg_op", f"osd.{self.host.whoami}") as sp:
+            if sp is not None:      # hot-toggle race: may disable mid-call
+                sp.set_tag("pg", f"{self.pgid.pool}.{self.pgid.ps}")
+                sp.set_tag("op", op.get("op"))
+                sp.set_tag("oid", op.get("oid"))
+                sp.set_tag("bytes", len(data))
+            rc, out, outdata = await self._do_op(op, data, conn)
+            if sp is not None:
+                sp.set_tag("rc", rc)
+            return rc, out, outdata
+
+    async def _do_op(self, op: dict, data: bytes,
+                     conn=None) -> tuple[int, dict, bytes]:
         if not self._active_event.is_set():
             # never BLOCK a queue shard on a peering PG: the daemon parks
             # ops at ingest and re-parks at dequeue; an op that still

@@ -21,6 +21,8 @@ from __future__ import annotations
 import asyncio
 from typing import Any, Callable
 
+from ceph_tpu.utils import tracer
+
 
 class AioCompletion:
     """One in-flight async op (AioCompletionImpl)."""
@@ -97,9 +99,13 @@ class AioDispatcher:
         async def run():
             acquired = False
             try:
-                await self._throttle.acquire()
-                acquired = True
-                comp._finish(await coro)
+                # the task inherits the submitter's trace context, so an
+                # aio op traced from application code stays one trace;
+                # this span additionally shows throttle-queue wait
+                with tracer.span("aio_op", "client"):
+                    await self._throttle.acquire()
+                    acquired = True
+                    comp._finish(await coro)
             except BaseException as e:
                 comp._finish(error=e)
             finally:

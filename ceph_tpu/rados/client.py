@@ -24,6 +24,7 @@ from ceph_tpu.msg.messages import (Message, MOSDOp, MOSDOpReply,
                                    MWatchNotify, MWatchNotifyAck)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
 from ceph_tpu.mon.mon_client import MonClient
+from ceph_tpu.utils import tracer
 from ceph_tpu.utils.dout import dout
 
 import json
@@ -147,7 +148,26 @@ class RadosClient(Dispatcher):
                      ) -> tuple[dict, bytes]:
         """Objecter::op_submit-lite: compute the target, send, resend on
         epoch change / wrong-primary / transport fault. `pgid` pins the
-        target PG (PG-scoped ops like `list`)."""
+        target PG (PG-scoped ops like `list`). When tracing is on, this
+        opens the ROOT span of the op's trace; every messenger hop and
+        OSD-side stage nests under it."""
+        if not tracer.enabled():
+            return await self._submit_inner(pool_name, oid, ops, data,
+                                            timeout, pgid, attempt_timeout)
+        with tracer.span("rados_op", "client") as sp:
+            if sp is not None:      # hot-toggle race: may disable mid-call
+                sp.set_tag("pool", pool_name)
+                sp.set_tag("oid", oid)
+                sp.set_tag("ops", "+".join(o.get("op", "?") for o in ops))
+                sp.set_tag("bytes", len(data))
+            return await self._submit_inner(pool_name, oid, ops, data,
+                                            timeout, pgid, attempt_timeout)
+
+    async def _submit_inner(self, pool_name: str, oid: str,
+                            ops: list[dict], data: bytes = b"",
+                            timeout: float | None = None, pgid=None,
+                            attempt_timeout: float | None = None
+                            ) -> tuple[dict, bytes]:
         deadline = time.monotonic() + (timeout or self.OP_TIMEOUT)
         last = "no attempt"
         # one reqid per LOGICAL op, stable across retries: the PG's

@@ -15,6 +15,7 @@ import socket
 import threading
 from typing import Callable
 
+from ceph_tpu.utils import tracer
 from ceph_tpu.utils.dout import get_logger
 from ceph_tpu.utils.perf_counters import PerfCountersCollection
 
@@ -56,6 +57,11 @@ class AdminSocket:
         self.register_command("dump_recent",
                               lambda req: get_logger().ring.entries(),
                               "recent log events")
+        self.register_command("trace dump",
+                              lambda req: tracer.dump(req.get("trace_id")),
+                              "collected op trace spans grouped by trace")
+        self.register_command("trace reset", lambda req: tracer.reset(),
+                              "clear the span collector")
         if self.config is not None:
             self.register_command("config show",
                                   lambda req: self.config.show(),

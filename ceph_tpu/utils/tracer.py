@@ -1,0 +1,307 @@
+"""Distributed op tracing: spans, context propagation, bounded collection.
+
+Re-creation of the reference's tracing integration (src/common/tracer.cc
+wrapping Jaeger/OpenTelemetry, doc/jaegertracing): a root span opened in
+the client threads through the messenger (the trace context rides the
+message frame, src/msg/Message.h otel_trace), the OSD op pipeline, the
+EC backend's encode dispatch and the objectstore commit, so "where did
+this 1 MiB EC write spend its time" is answerable per stage.
+
+Design:
+  * `Span`: trace/span/parent ids, service + name, wall-clock start,
+    monotonic duration, free-form tags. Finished spans land in a
+    process-wide bounded `SpanCollector` (the in-memory stand-in for a
+    Jaeger agent; every daemon in this stack can dump it over its admin
+    socket as `trace dump`).
+  * context propagation: a contextvar carries (trace_id, span_id); tasks
+    inherit it at creation, `span()` nests under it, and
+    `current_context()` / `span(parent=ctx)` move it across the wire
+    (msg/frames.py encodes it as an optional trailing TLV segment that
+    old peers simply never send).
+  * gating: tracing is OFF by default and hot-togglable through the
+    config observer (`tracer_enabled`, `tracer_max_spans`). When off,
+    `span()` returns one shared no-op context manager and
+    `current_context()` returns None — the op path allocates no span
+    objects and pays two global reads.
+"""
+from __future__ import annotations
+
+import collections
+import contextvars
+import random
+import threading
+import time
+from typing import Any, Iterator
+
+#: (trace_id, span_id) of the span the current task is inside, if any
+_current: contextvars.ContextVar[tuple[int, int] | None] = \
+    contextvars.ContextVar("trace_ctx", default=None)
+
+_enabled = False
+
+
+def _new_id() -> int:
+    return random.getrandbits(63) or 1
+
+
+class Span:
+    """One timed operation stage within a trace."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "service",
+                 "start", "_t0", "duration_us", "tags", "_done")
+
+    def __init__(self, name: str, service: str, trace_id: int,
+                 parent_id: int | None):
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.name = name
+        self.service = service
+        self.start = time.time()
+        self._t0 = time.perf_counter()
+        self.duration_us = 0.0
+        self.tags: dict[str, Any] = {}
+        self._done = False
+
+    def set_tag(self, key: str, value: Any) -> None:
+        self.tags[key] = value
+
+    def finish(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.duration_us = round((time.perf_counter() - self._t0) * 1e6, 1)
+        _collector.add(self)
+
+    def context(self) -> dict:
+        """Wire form of this span as a parent ({"t": trace, "s": span})."""
+        return {"t": self.trace_id, "s": self.span_id}
+
+    def to_dict(self) -> dict:
+        return {"trace_id": format(self.trace_id, "016x"),
+                "span_id": format(self.span_id, "016x"),
+                "parent_id": (format(self.parent_id, "016x")
+                              if self.parent_id else None),
+                "name": self.name, "service": self.service,
+                "start": self.start, "duration_us": self.duration_us,
+                "tags": dict(self.tags)}
+
+
+class SpanCollector:
+    """Bounded per-process store of finished spans (Jaeger-agent role)."""
+
+    def __init__(self, max_spans: int = 4096):
+        self._lock = threading.Lock()
+        self._spans: collections.deque[Span] = \
+            collections.deque(maxlen=max_spans)
+        self.dropped = 0
+
+    def set_max_spans(self, n: int) -> None:
+        with self._lock:
+            self._spans = collections.deque(self._spans, maxlen=max(n, 16))
+
+    def add(self, span: Span) -> None:
+        with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self.dropped += 1
+            self._spans.append(span)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def spans(self) -> list[dict]:
+        with self._lock:
+            return [s.to_dict() for s in self._spans]
+
+    def reset(self) -> int:
+        with self._lock:
+            n = len(self._spans)
+            self._spans.clear()
+            self.dropped = 0
+            return n
+
+
+_collector = SpanCollector()
+
+
+# -- span creation ------------------------------------------------------------
+
+class _NoopSpanCM:
+    """Shared do-nothing context manager returned while tracing is off."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP = _NoopSpanCM()
+
+
+class _SpanCM:
+    """Context manager making a live span the current trace context."""
+
+    __slots__ = ("span", "_token")
+
+    def __init__(self, span: Span):
+        self.span = span
+
+    def __enter__(self) -> Span:
+        self._token = _current.set((self.span.trace_id, self.span.span_id))
+        return self.span
+
+    def __exit__(self, et, ev, tb) -> bool:
+        _current.reset(self._token)
+        if et is not None:
+            self.span.tags.setdefault("error", f"{et.__name__}: {ev}")
+        self.span.finish()
+        return False
+
+
+def _parse_parent(parent) -> tuple[int, int] | None:
+    """Accept a wire dict {"t","s"}, an (trace, span) tuple, or a Span."""
+    if parent is None:
+        return None
+    if isinstance(parent, Span):
+        return (parent.trace_id, parent.span_id)
+    if isinstance(parent, dict):
+        try:
+            return (int(parent["t"]), int(parent["s"]))
+        except (KeyError, TypeError, ValueError):
+            return None
+    try:
+        t, s = parent
+        return (int(t), int(s))
+    except (TypeError, ValueError):
+        return None
+
+
+def start_span(name: str, service: str = "",
+               parent=None) -> Span | None:
+    """Create a span (child of `parent`, else of the current context,
+    else a new root). Returns None while tracing is disabled — callers
+    on hot paths must treat None as "do nothing"."""
+    if not _enabled:
+        return None
+    ctx = _parse_parent(parent) or _current.get()
+    if ctx is None:
+        return Span(name, service, _new_id(), None)
+    return Span(name, service, ctx[0], ctx[1])
+
+
+def span(name: str, service: str = "", parent=None):
+    """`with tracer.span("pg_op") as sp:` — sp is the Span, or None when
+    tracing is off (the same shared no-op is returned, nothing is
+    allocated)."""
+    if not _enabled:
+        return _NOOP
+    s = start_span(name, service, parent)
+    if s is None:                       # disabled raced mid-call
+        return _NOOP
+    return _SpanCM(s)
+
+
+def current_context() -> dict | None:
+    """The wire-form trace context of the current task, or None (also
+    None whenever tracing is off, so callers can gate on it)."""
+    if not _enabled:
+        return None
+    ctx = _current.get()
+    if ctx is None:
+        return None
+    return {"t": ctx[0], "s": ctx[1]}
+
+
+# -- gating + config ----------------------------------------------------------
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(max_spans: int | None = None) -> None:
+    global _enabled
+    if max_spans is not None:
+        _collector.set_max_spans(max_spans)
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def register_config(config) -> None:
+    """Declare the tracer options on `config` (idempotent) and watch
+    them: `config set tracer_enabled true` over an admin socket turns
+    tracing on live (md_config_obs_t-style hot reload)."""
+    from ceph_tpu.utils.config import ConfigError, Option
+    for opt in (Option("tracer_enabled", "bool", False,
+                       "collect op trace spans (hot-togglable)"),
+                Option("tracer_max_spans", "int", 4096,
+                       "bounded span collector size", minimum=16)):
+        try:
+            config.declare(opt)
+        except ConfigError:
+            pass                        # already declared by another daemon
+
+    def _on_change(name: str, value) -> None:
+        if name == "tracer_max_spans":
+            _collector.set_max_spans(int(value))
+        elif name == "tracer_enabled":
+            enable() if value else disable()
+
+    config.add_observer(("tracer_enabled", "tracer_max_spans"), _on_change)
+    if config.get("tracer_enabled"):
+        enable(config.get("tracer_max_spans"))
+
+
+# -- dump surface (admin socket `trace dump` / `trace reset`) -----------------
+
+def collector() -> SpanCollector:
+    return _collector
+
+
+def reset() -> dict:
+    return {"cleared": _collector.reset()}
+
+
+def _group(spans: list[dict]) -> Iterator[tuple[str, list[dict]]]:
+    by: dict[str, list[dict]] = {}
+    for s in spans:
+        by.setdefault(s["trace_id"], []).append(s)
+    for tid, ss in by.items():
+        ss.sort(key=lambda s: s["start"])
+        yield tid, ss
+
+
+def dump(trace_id: str | None = None) -> dict:
+    """Collected spans grouped into traces (admin `trace dump`)."""
+    traces = []
+    for tid, ss in _group(_collector.spans()):
+        if trace_id is not None and tid != trace_id:
+            continue
+        roots = [s for s in ss if s["parent_id"] is None]
+        traces.append({
+            "trace_id": tid,
+            "root": roots[0]["name"] if roots else ss[0]["name"],
+            "services": sorted({s["service"] for s in ss if s["service"]}),
+            "num_spans": len(ss),
+            "duration_us": max(s["duration_us"] for s in ss),
+            "spans": ss,
+        })
+    traces.sort(key=lambda t: t["spans"][0]["start"], reverse=True)
+    return {"enabled": _enabled, "num_spans": len(_collector),
+            "dropped": _collector.dropped, "traces": traces}
+
+
+def recent_traces(limit: int = 20) -> list[dict]:
+    """Trace summaries (newest first) for the mgr dashboard table."""
+    out = []
+    for t in dump()["traces"][:limit]:
+        out.append({k: t[k] for k in ("trace_id", "root", "services",
+                                      "num_spans", "duration_us")})
+    return out

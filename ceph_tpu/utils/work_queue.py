@@ -56,7 +56,7 @@ class TrackedOp:
     """One op's lifetime: description + stamped event timeline."""
 
     __slots__ = ("tracker", "seq", "description", "initiated_at",
-                 "_t0", "events", "done")
+                 "_t0", "events", "done", "trace")
 
     def __init__(self, tracker: "OpTracker", seq: int, description: str):
         self.tracker = tracker
@@ -66,6 +66,11 @@ class TrackedOp:
         self._t0 = time.monotonic()
         self.events: list[tuple[float, str]] = [(0.0, "initiated")]
         self.done = False
+        # tracer wire context ({"t","s"}) captured at ingest: carries the
+        # trace through the sharded queue (closures run in a different
+        # task, so the contextvar alone cannot), and lets historic-op
+        # dumps name the trace an op belongs to
+        self.trace: dict | None = None
 
     def mark_event(self, event: str) -> None:
         self.events.append((round(time.monotonic() - self._t0, 6), event))
@@ -82,10 +87,13 @@ class TrackedOp:
             self.tracker._finished(self)
 
     def to_dict(self) -> dict:
-        return {"seq": self.seq, "description": self.description,
-                "initiated_at": self.initiated_at,
-                "age": round(self.duration, 6),
-                "events": [{"t": t, "event": e} for t, e in self.events]}
+        out = {"seq": self.seq, "description": self.description,
+               "initiated_at": self.initiated_at,
+               "age": round(self.duration, 6),
+               "events": [{"t": t, "event": e} for t, e in self.events]}
+        if self.trace is not None:
+            out["trace_id"] = format(self.trace["t"], "016x")
+        return out
 
 
 class OpTracker:
