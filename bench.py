@@ -154,6 +154,9 @@ def main() -> int:
         "value": value,
         "unit": "GB/s",
         "vs_baseline": vs,
+        # cluster observability snapshot (status, check codes,
+        # per-daemon report ages) from the cluster stage's health probe
+        "health": detail.pop("health", None),
         "baseline": baseline_name,
         "platform": device.get("platform", "none"),
         "detail": detail,

@@ -34,10 +34,12 @@ import asyncio
 import json
 import time
 
+from ceph_tpu.mgr.mgr_client import MgrClient
 from ceph_tpu.msg.messages import MClientReply, MClientRequest, Message
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
 from ceph_tpu.rados.client import ObjectNotFound, RadosClient, RadosError
 from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.perf_counters import TYPE_AVG, PerfCountersCollection
 
 ROOT_INO = 1
 DEFAULT_STRIPE = 1 << 22          # 4 MiB objects (file_layout_t default)
@@ -60,31 +62,54 @@ class MDSDaemon(Dispatcher):
 
     def __init__(self, mon_addrs, metadata_pool: str = "cephfs_metadata",
                  data_pool: str = "cephfs_data",
-                 auth_key: bytes | None = None):
+                 auth_key: bytes | None = None, name: str = "mds.a"):
+        self.name = name
         self.rados = RadosClient(mon_addrs, auth_key=auth_key)
         self.metadata_pool = metadata_pool
         self.data_pool = data_pool
-        self.messenger = Messenger("mds", auth_key=auth_key)
+        self.messenger = Messenger(name, auth_key=auth_key)
         self.messenger.add_dispatcher(self)
         self.addr: tuple[str, int] | None = None
         self._mdlock = asyncio.Lock()     # one mutation at a time
         self._journal_seq = 0
         self._since_trim = 0
         self.stripe_unit = DEFAULT_STRIPE
+        # per-daemon perf counters, shipped to the mgr like every
+        # other daemon's (src/mds/MDSDaemon.cc mds_server counters)
+        coll = PerfCountersCollection.instance()
+        coll.remove(name)               # a restarted rank re-registers
+        self.perf = coll.create(name)
+        self.perf.add("request", description="client requests handled")
+        self.perf.add("request_latency", type=TYPE_AVG,
+                      description="client request latency (seconds)")
+        self.perf.add("reply_err",
+                      description="client requests answered with errors")
+        self.perf.add("journal_event",
+                      description="metadata events journaled")
+        self.mgr_client = MgrClient(
+            self.messenger, name, "mds",
+            resolve=lambda: (self.rados.monc.mgrmap
+                             or {}).get("active_addr"),
+            status_cb=lambda: {"metadata_pool": self.metadata_pool,
+                               "data_pool": self.data_pool,
+                               "journal_seq": self._journal_seq})
 
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
         await self.rados.connect()
+        self.rados.monc.subscribe("mgrmap", 1)
         self.meta = self.rados.ioctx(self.metadata_pool)
         self.data = self.rados.ioctx(self.data_pool)
         await self._bootstrap_fs()
         await self._replay_journal()
         self.addr = await self.messenger.bind(host, port)
+        self.mgr_client.start()
         dout("mds", 1, f"mds up at {self.addr} "
                        f"(meta={self.metadata_pool} data={self.data_pool})")
 
     async def stop(self) -> None:
+        await self.mgr_client.stop()
         await self.rados.shutdown()
         await self.messenger.shutdown()
 
@@ -124,6 +149,7 @@ class MDSDaemon(Dispatcher):
         event = dict(event, seq=self._journal_seq)
         await self.meta.append(
             MDLOG_OID, json.dumps(event).encode() + b"\n")
+        self.perf.inc("journal_event")
 
     async def _trim_journal(self) -> None:
         """Applied events need no replay: reset the log (LogSegment
@@ -237,6 +263,8 @@ class MDSDaemon(Dispatcher):
         if not isinstance(msg, MClientRequest):
             return False
         p = msg.payload
+        t0 = time.monotonic()
+        self.perf.inc("request")
         try:
             handler = getattr(self, f"_op_{p['op']}", None)
             if handler is None:
@@ -249,9 +277,11 @@ class MDSDaemon(Dispatcher):
             conn.send_message(MClientReply(
                 {"tid": p.get("tid", 0), "rc": 0, "out": out}))
         except FSError as e:
+            self.perf.inc("reply_err")
             conn.send_message(MClientReply(
                 {"tid": p.get("tid", 0), "rc": e.rc, "error": str(e)}))
         except (RadosError, TimeoutError) as e:
+            self.perf.inc("reply_err")
             conn.send_message(MClientReply(
                 {"tid": p.get("tid", 0), "rc": -5,
                  "error": f"{type(e).__name__}: {e}"}))
@@ -259,9 +289,12 @@ class MDSDaemon(Dispatcher):
             # a malformed request or corrupt record must still ANSWER:
             # a dropped exception would leave the client hanging its
             # full request timeout (the monitor replies rc=-22 likewise)
+            self.perf.inc("reply_err")
             conn.send_message(MClientReply(
                 {"tid": p.get("tid", 0), "rc": -22,
                  "error": f"{type(e).__name__}: {e}"}))
+        finally:
+            self.perf.avg_add("request_latency", time.monotonic() - t0)
         return True
 
     # -- operations (Server.cc handle_client_* subset) -----------------------

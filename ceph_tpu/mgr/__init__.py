@@ -6,8 +6,9 @@ its analog: an HTTP endpoint exposing every PerfCounters metric in the
 process plus cluster health, in the prometheus text format.
 """
 from ceph_tpu.mgr.exporter import MetricsExporter
-from ceph_tpu.mgr.daemon import (BalancerModule, MgrDaemon, MgrModule,
-                                 PGAutoscalerModule)
+from ceph_tpu.mgr.daemon import (BalancerModule, DaemonStateIndex,
+                                 MgrDaemon, MgrModule, PGAutoscalerModule)
+from ceph_tpu.mgr.mgr_client import MgrClient
 
-__all__ = ["MetricsExporter", "MgrDaemon", "MgrModule",
-           "BalancerModule", "PGAutoscalerModule"]
+__all__ = ["MetricsExporter", "MgrDaemon", "MgrModule", "MgrClient",
+           "DaemonStateIndex", "BalancerModule", "PGAutoscalerModule"]

@@ -17,22 +17,127 @@ Modules shipped (src/pybind/mgr/ equivalents):
     size toward ~100 PGs/OSD (src/pybind/mgr/pg_autoscaler/module.py
     _get_pool_status); report-only, like the autoscaler in warn mode.
 
+Daemon metrics arrive as MMgrReport messages over real sockets: every
+daemon (osd, mon, mds, rgw) opens a session (MMgrOpen), ships its
+perf-counter schema once, then changed values, plus a daemon_status
+blob, health metrics, and progress events (src/mgr/DaemonServer.cc
+handle_report -> DaemonStateIndex). The mgr aggregates health metrics
+into a digest it ships to the mon (MMonMgrReport), where the health
+engine turns them into SLOW_OPS / PG_DEGRADED / OSD_NEARFULL checks.
+
 Idiomatic divergences: modules are plain Python objects ticked by the
 mgr loop (no CPython-embedding/Gil machinery needed — the whole daemon
-is Python); daemon metric aggregation reads the in-process
-PerfCountersCollection registry instead of MMgrReport messages.
+is Python).
 """
 from __future__ import annotations
 
 import asyncio
+import time
 
 from ceph_tpu.crush.osdmap import Incremental, OSDMap, PG
 from ceph_tpu.mgr.exporter import MetricsExporter
 from ceph_tpu.mon.mon_client import MonClient
-from ceph_tpu.msg.messenger import Messenger
+from ceph_tpu.msg.messages import (Message, MMgrConfigure, MMgrOpen,
+                                   MMgrReport)
+from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
 from ceph_tpu.utils.dout import dout
 
 import json
+
+
+class DaemonState:
+    """One reporting daemon's aggregated state (src/mgr/DaemonState.h)."""
+
+    __slots__ = ("name", "service", "schema", "counters", "status",
+                 "health_metrics", "progress", "last_report_mono",
+                 "reports")
+
+    def __init__(self, name: str, service: str):
+        self.name = name
+        self.service = service
+        self.schema: dict = {}
+        self.counters: dict = {}
+        self.status: dict = {}
+        self.health_metrics: dict = {}
+        self.progress: list = []
+        self.last_report_mono = time.monotonic()
+        self.reports = 0
+
+    @property
+    def age(self) -> float:
+        return time.monotonic() - self.last_report_mono
+
+
+class DaemonStateIndex:
+    """name -> DaemonState with staleness eviction
+    (src/mgr/DaemonState.h DaemonStateIndex; entries whose reports stop
+    are culled so a dead daemon's metrics never linger in /metrics)."""
+
+    STALE_AFTER = 8.0           # seconds without a report before eviction
+
+    def __init__(self, stale_after: float | None = None):
+        self.stale_after = stale_after if stale_after is not None \
+            else self.STALE_AFTER
+        self.daemons: dict[str, DaemonState] = {}
+
+    def open(self, name: str, service: str) -> DaemonState:
+        st = self.daemons.get(name)
+        if st is None or st.service != service:
+            st = self.daemons[name] = DaemonState(name, service)
+        else:
+            # a re-opened session (daemon restart) restarts the
+            # staleness clock: the entry must not be culled in the gap
+            # between MMgrOpen and the first MMgrReport
+            st.last_report_mono = time.monotonic()
+        return st
+
+    def report(self, payload: dict) -> DaemonState:
+        name = payload.get("daemon_name", "?")
+        st = self.open(name, payload.get("service", "?"))
+        schema = payload.get("schema")
+        if schema is not None:
+            # a schema resend means a fresh session (or a restarted
+            # daemon re-registering): stored values are stale
+            st.schema = schema
+            st.counters = {}
+        # deltas: only changed keys travel; merge into the stored copy
+        st.counters.update(payload.get("counters") or {})
+        st.status = payload.get("daemon_status") or {}
+        st.health_metrics = payload.get("health_metrics") or {}
+        st.progress = payload.get("progress") or []
+        st.last_report_mono = time.monotonic()
+        st.reports += 1
+        return st
+
+    def cull(self) -> list[str]:
+        """Evict daemons whose reports stopped; returns evicted names."""
+        evicted = [name for name, st in self.daemons.items()
+                   if st.age > self.stale_after]
+        for name in evicted:
+            del self.daemons[name]
+        return evicted
+
+    def render_sources(self) -> list[tuple[str, dict, dict]]:
+        """(daemon, schema, counters) triples for the exporter."""
+        return [(name, st.schema, st.counters)
+                for name, st in sorted(self.daemons.items())]
+
+    def report_ages(self) -> dict[str, float]:
+        return {name: round(st.age, 3)
+                for name, st in sorted(self.daemons.items())}
+
+    def progress_events(self) -> list[dict]:
+        out = []
+        for name, st in sorted(self.daemons.items()):
+            for ev in st.progress:
+                out.append(dict(ev, daemon=name))
+        return out
+
+    def summary(self) -> dict:
+        return {name: {"service": st.service, "age_s": round(st.age, 2),
+                       "reports": st.reports,
+                       "num_counters": len(st.counters)}
+                for name, st in sorted(self.daemons.items())}
 
 
 class MgrModule:
@@ -47,26 +152,39 @@ class MgrModule:
         return {}
 
 
-class MgrDaemon:
+class MgrDaemon(Dispatcher):
 
     TICK_INTERVAL = 1.0
+    REPORT_PERIOD = 1.0         # handed to daemons via MMgrConfigure
+    NEARFULL_RATIO = 0.85       # mon_osd_nearfull_ratio analog
+    FULL_RATIO = 0.95           # mon_osd_full_ratio analog
 
     def __init__(self, mon_addrs, modules: list[MgrModule] | None = None,
                  auth_key: bytes | None = None,
-                 exporter_port: int | None = 0):
-        self.messenger = Messenger("mgr", auth_key=auth_key)
+                 exporter_port: int | None = 0,
+                 name: str = "x"):
+        self.name = name
+        self.messenger = Messenger(f"mgr.{name}", auth_key=auth_key)
+        self.messenger.add_dispatcher(self)
         self.monc = MonClient(self.messenger, mon_addrs)
         self.monc.on_osdmap = self._on_osdmap
         self.osdmap = OSDMap()
         self.modules = modules if modules is not None else \
             [BalancerModule(), PGAutoscalerModule()]
         self.health: dict = {}
+        self.daemon_index = DaemonStateIndex()
+        self.addr: tuple[str, int] | None = None
+        # True while the mgrmap names us active; standbys keep their
+        # (empty) digest to themselves so they can never overwrite the
+        # active mgr's digest at the mon
+        self.is_active = False
         self._tick_task: asyncio.Task | None = None
+        self._beacon_task: asyncio.Task | None = None
         self.exporter: MetricsExporter | None = None
         self._exporter_port = exporter_port
 
     async def start(self) -> None:
-        await self.messenger.bind("127.0.0.1", 0)
+        self.addr = await self.messenger.bind("127.0.0.1", 0)
         await self.monc.start()
         self.monc.subscribe("osdmap", 1)
         if self._exporter_port is not None:
@@ -82,24 +200,31 @@ class MgrDaemon:
                     status["modules"] = self.module_status()
                 except Exception as e:
                     status["modules"] = {"error": str(e)}
+                status["daemon_reports"] = self.daemon_index.summary()
+                status["progress_events"] = \
+                    self.daemon_index.progress_events()
                 return status
             self.exporter = MetricsExporter(
                 port=self._exporter_port, health_cb=health_cb,
-                status_cb=status_cb)
+                status_cb=status_cb, index=self.daemon_index)
             await self.exporter.start()
         self._tick_task = asyncio.get_running_loop().create_task(
             self._tick_loop())
+        self._beacon_task = asyncio.get_running_loop().create_task(
+            self._beacon_loop())
         dout("mgr", 1, "mgr up "
              + (f"(metrics on {self.exporter.addr})"
                 if self.exporter else "(no exporter)"))
 
     async def stop(self) -> None:
-        if self._tick_task is not None:
-            self._tick_task.cancel()
-            import contextlib
-            with contextlib.suppress(asyncio.CancelledError):
-                await self._tick_task
-            self._tick_task = None
+        import contextlib
+        for attr in ("_tick_task", "_beacon_task"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await task
+                setattr(self, attr, None)
         if self.exporter is not None:
             await self.exporter.stop()
         await self.monc.close()
@@ -113,8 +238,59 @@ class MgrDaemon:
     async def mon_command(self, cmd: dict) -> dict:
         return await self.monc.command(cmd, timeout=15.0)
 
+    # -- report fan-in (DaemonServer.cc handle_open/handle_report) -----------
+
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, MMgrOpen):
+            p = msg.payload
+            self.daemon_index.open(p.get("daemon_name", "?"),
+                                   p.get("service", "?"))
+            conn.send_message(MMgrConfigure({"period": self.REPORT_PERIOD}))
+            return True
+        if isinstance(msg, MMgrReport):
+            self.daemon_index.report(msg.payload)
+            return True
+        return False
+
+    async def _beacon_loop(self) -> None:
+        """Beacons ride their own task so the mgrmap liveness signal can
+        never be starved by a slow health poll or module tick (the mon
+        drops the active mgr after BEACON_GRACE without one). The reply
+        names the active mgr — standby semantics key off it."""
+        while True:
+            try:
+                out = await self.monc.command(
+                    {"prefix": "mgr beacon", "name": self.name,
+                     "addr": list(self.addr) if self.addr else None},
+                    timeout=3.0)
+                self.is_active = out.get("active_name") == self.name
+            except Exception as e:
+                dout("mgr", 4, f"mgr beacon failed: "
+                               f"{type(e).__name__} {e}")
+            await asyncio.sleep(self.TICK_INTERVAL)
+
     async def _tick_loop(self) -> None:
         while True:
+            for name in self.daemon_index.cull():
+                if not self.is_active:
+                    continue
+                dout("mgr", 2, f"mgr: daemon {name} stopped reporting; "
+                               f"evicted")
+                try:
+                    await self.monc.send_log(
+                        "WRN", f"mgr.{self.name}",
+                        f"daemon {name} stopped reporting; evicted from "
+                        f"the daemon index")
+                except Exception:
+                    pass
+            if self.is_active:
+                # standbys hold no daemon sessions: an empty digest from
+                # one must never clobber the active mgr's at the mon
+                try:
+                    await self.monc.send_mgr_report(self._build_digest())
+                except Exception as e:
+                    dout("mgr", 4, f"mgr digest send failed: "
+                                   f"{type(e).__name__} {e}")
             try:
                 self.health = await self.mon_command({"prefix": "health"})
             except Exception as e:
@@ -127,6 +303,72 @@ class MgrDaemon:
                     dout("mgr", 2, f"mgr module {mod.NAME} failed: "
                                    f"{type(e).__name__} {e}")
             await asyncio.sleep(self.TICK_INTERVAL)
+
+    def _build_digest(self) -> dict:
+        """Aggregate daemon health metrics into the health-check digest
+        the mon merges (MMonMgrReport; the reference mgr computes
+        SLOW_OPS and fullness checks the same way in DaemonServer.cc
+        send_report)."""
+        checks: dict[str, dict] = {}
+        slow_total, slow_oldest, slow_detail = 0, 0.0, []
+        degraded, undersized = [], []
+        nearfull, full = [], []
+        for name, st in sorted(self.daemon_index.daemons.items()):
+            hm = st.health_metrics or {}
+            n = int(hm.get("slow_ops") or 0)
+            if n:
+                slow_total += n
+                slow_oldest = max(slow_oldest,
+                                  float(hm.get("slow_ops_oldest_age_s")
+                                        or 0.0))
+                slow_detail.append(f"{name} has {n} slow ops")
+            if hm.get("degraded_pgs"):
+                degraded.append((name, int(hm["degraded_pgs"])))
+            if hm.get("undersized_pgs"):
+                undersized.append((name, int(hm["undersized_pgs"])))
+            store = hm.get("store") or {}
+            util = float(store.get("utilization") or 0.0)
+            if util >= self.FULL_RATIO:
+                full.append((name, util))
+            elif util >= self.NEARFULL_RATIO:
+                nearfull.append((name, util))
+        if slow_total:
+            checks["SLOW_OPS"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{slow_total} slow ops, oldest one blocked "
+                           f"for {slow_oldest:.1f} sec",
+                "detail": slow_detail}
+        if degraded:
+            # primaries report their own PGs, so daemon counts sum
+            # without double counting
+            checks["PG_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{sum(n for _, n in degraded)} pgs degraded",
+                "detail": [f"{d}: {n} pgs degraded" for d, n in degraded]}
+        if undersized:
+            checks["PG_UNDERSIZED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{sum(n for _, n in undersized)} pgs "
+                           f"undersized",
+                "detail": [f"{d}: {n} pgs undersized"
+                           for d, n in undersized]}
+        if nearfull:
+            checks["OSD_NEARFULL"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(nearfull)} osds near full",
+                "detail": [f"{d} is {u:.0%} full" for d, u in nearfull]}
+        if full:
+            checks["OSD_FULL"] = {
+                "severity": "HEALTH_ERR",
+                "summary": f"{len(full)} osds full",
+                "detail": [f"{d} is {u:.0%} full" for d, u in full]}
+        return {"from": self.name,
+                "checks": checks,
+                "progress": self.daemon_index.progress_events(),
+                "daemons": {name: {"service": st.service,
+                                   "age_s": round(st.age, 2)}
+                            for name, st in
+                            sorted(self.daemon_index.daemons.items())}}
 
     def module_status(self) -> dict:
         return {m.NAME: m.status() for m in self.modules}

@@ -2,11 +2,18 @@
 
 Re-creation of the reference exporter's surface
 (src/pybind/mgr/prometheus/module.py: GET /metrics, text format 0.0.4;
-src/exporter/ for the per-daemon variant): every PerfCounters instance
-in the process is exported as `ceph_<counter>{daemon="..."} value`;
-avg counters split into _sum/_count like prometheus summaries; an
-optional health callback adds `ceph_health_status` (0=OK 1=WARN 2=ERR)
-and per-check gauges. GET /health returns the raw health JSON.
+src/exporter/ for the per-daemon variant): every counter aggregated
+from daemon MMgrReport sessions (mgr/daemon.py DaemonStateIndex) is
+exported as `ceph_<counter>{ceph_daemon="osd.0"} value` — the labels
+name the REPORTING daemon, so a multi-daemon cluster's osd/mon/mds/rgw
+series all appear in one scrape. When no reports exist (standalone
+exporter, or a mgr that daemons have not found yet) the in-process
+PerfCountersCollection registry is the fallback source. avg counters
+split into _sum/_count (prometheus summaries), histograms into
+cumulative _bucket series; every family carries exactly one `# TYPE`
+line. An optional health callback adds `ceph_health_status`
+(0=OK 1=WARN 2=ERR) and per-check gauges; progress events become
+`ceph_progress_*` gauges. GET /health returns the raw health JSON.
 
 HTTP/1.0 server on asyncio — no external dependencies.
 """
@@ -24,57 +31,110 @@ _SEVERITY = {"HEALTH_OK": 0, "HEALTH_WARN": 1, "HEALTH_ERR": 2}
 
 
 def _sanitize(name: str) -> str:
-    return "".join(ch if ch.isalnum() or ch == "_" else "_"
+    """Metric-NAME sanitizer: prometheus names are [a-z0-9_] here (the
+    metrics-name lint enforces it). Label values keep their case — use
+    _label_escape for those."""
+    return "".join(ch.lower() if ch.isalnum() or ch == "_" else "_"
                    for ch in name)
 
 
-def render_metrics(health: dict | None = None) -> str:
-    """The /metrics payload: every registered counter, text format."""
-    out: list[str] = []
-    dump = PerfCountersCollection.instance().dump()
-    seen_types: set[str] = set()
-    for daemon, counters in sorted(dump.items()):
-        label = f'daemon="{daemon}"'
+def _label_escape(value: str) -> str:
+    return value.replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+def _render_value(metric: str, label: str, ctype: str | None,
+                  value) -> tuple[list[str], str]:
+    """One counter's sample lines + its prometheus family type. The
+    schema type wins; value shape is the fallback (a report may carry
+    values whose schema line was lost to a truncated session)."""
+    if ctype == "avg" or (isinstance(value, dict) and "avgcount" in value):
+        value = value if isinstance(value, dict) else {}
+        return ([f"{metric}_sum{{{label}}} {value.get('sum', 0.0)}",
+                 f"{metric}_count{{{label}}} {value.get('avgcount', 0)}"],
+                "summary")
+    if ctype == "histogram" or isinstance(value, dict):
+        # cumulative histogram series. Internal bucket i counts values
+        # in [2^i, 2^(i+1)), so `le` is the numeric upper bound
+        # 2^(i+1) in the counter's recorded unit (*_us = µs)
+        value = value if isinstance(value, dict) else {}
+        counts = {int(b[2:]): n
+                  for b, n in value.get("buckets", {}).items()}
+        rows, cum = [], 0
+        for exp in sorted(counts):
+            cum += counts[exp]
+            rows.append(f'{metric}_bucket{{{label},'
+                        f'le="{2 ** (exp + 1)}"}} {cum}')
+        rows.append(f'{metric}_bucket{{{label},le="+Inf"}} '
+                    f"{value.get('count', cum)}")
+        rows.append(f"{metric}_sum{{{label}}} {value.get('sum', 0.0)}")
+        rows.append(f"{metric}_count{{{label}}} "
+                    f"{value.get('count', cum)}")
+        return rows, "histogram"
+    return ([f"{metric}{{{label}}} {value}"],
+            "gauge" if ctype == "gauge" else "counter")
+
+
+def render_metrics(health: dict | None = None, index=None) -> str:
+    """The /metrics payload: aggregated per-daemon counters (or the
+    local registry when no daemon reports exist), text format."""
+    sources: list[tuple[str, dict, dict]] = \
+        index.render_sources() if index is not None else []
+    from_reports = bool(sources)
+    if not from_reports:
+        coll = PerfCountersCollection.instance()
+        dump, schema = coll.dump(), coll.schema()
+        sources = [(daemon, schema.get(daemon, {}), counters)
+                   for daemon, counters in sorted(dump.items())]
+    # group sample rows by family so each metric gets exactly ONE
+    # `# TYPE` line however many daemons carry it
+    families: dict[str, dict] = {}
+    for daemon, schema, counters in sources:
+        # daemon names arrive in remote MMgrOpen payloads: one bad name
+        # must not break the whole scrape's text-format parse
+        label = f'ceph_daemon="{_label_escape(daemon)}"'
         for key, value in sorted(counters.items()):
             metric = f"ceph_{_sanitize(key)}"
-            if isinstance(value, dict) and "avgcount" in value:
-                for suffix, v in (("_sum", value.get("sum", 0.0)),
-                                  ("_count", value["avgcount"])):
-                    out.append(f"{metric}{suffix}{{{label}}} {v}")
-                continue
-            if isinstance(value, dict):
-                # TYPE_HISTOGRAM: proper cumulative prometheus histogram
-                # series. Internal bucket i counts values in
-                # [2^i, 2^(i+1)), so `le` is the numeric upper bound
-                # 2^(i+1) in the counter's recorded unit (*_us = µs)
-                if metric not in seen_types:
-                    out.append(f"# TYPE {metric} histogram")
-                    seen_types.add(metric)
-                counts = {int(b[2:]): n
-                          for b, n in value.get("buckets", {}).items()}
-                cum = 0
-                for exp in sorted(counts):
-                    cum += counts[exp]
-                    out.append(f'{metric}_bucket{{{label},'
-                               f'le="{2 ** (exp + 1)}"}} {cum}')
-                out.append(f'{metric}_bucket{{{label},le="+Inf"}} '
-                           f"{value.get('count', cum)}")
-                out.append(f"{metric}_sum{{{label}}} "
-                           f"{value.get('sum', 0.0)}")
-                out.append(f"{metric}_count{{{label}}} "
-                           f"{value.get('count', cum)}")
-                continue
-            if metric not in seen_types:
-                out.append(f"# TYPE {metric} counter")
-                seen_types.add(metric)
-            out.append(f"{metric}{{{label}}} {value}")
+            ctype = (schema.get(key) or {}).get("type") \
+                if schema else None
+            rows, ftype = _render_value(metric, label, ctype, value)
+            fam = families.setdefault(metric,
+                                      {"type": ftype, "rows": []})
+            fam["rows"].extend(rows)
+    if from_reports:
+        fam = families.setdefault("ceph_daemon_report_age_seconds",
+                                  {"type": "gauge", "rows": []})
+        for daemon, age in index.report_ages().items():
+            fam["rows"].append(
+                f'ceph_daemon_report_age_seconds'
+                f'{{ceph_daemon="{_label_escape(daemon)}"}} {age}')
+        prog = families.setdefault("ceph_progress_fraction",
+                                   {"type": "gauge", "rows": []})
+        for ev in index.progress_events():
+            prog["rows"].append(
+                f'ceph_progress_fraction'
+                f'{{id="{_label_escape(str(ev.get("id", "?")))}",'
+                f'ceph_daemon="{_label_escape(str(ev.get("daemon", "?")))}"}} '
+                f'{ev.get("progress", 0.0)}')
+        if not prog["rows"]:
+            del families["ceph_progress_fraction"]
+    out: list[str] = []
+    for metric in sorted(families):
+        out.append(f"# TYPE {metric} {families[metric]['type']}")
+        out.extend(families[metric]["rows"])
     if health is not None:
         out.append("# TYPE ceph_health_status gauge")
-        out.append(f"ceph_health_status "
+        out.append(f"ceph_health_status{{}} "
                    f"{_SEVERITY.get(health.get('status'), 2)}")
-        for name, chk in health.get("checks", {}).items():
-            out.append(f'ceph_health_detail{{check="{_sanitize(name)}",'
-                       f'severity="{chk.get("severity")}"}} 1')
+        checks = dict(health.get("checks", {}))
+        for name in health.get("muted", {}):
+            checks.setdefault(name, {"severity": "MUTED"})
+        if checks:
+            out.append("# TYPE ceph_health_detail gauge")
+            for name, chk in sorted(checks.items()):
+                out.append(
+                    f'ceph_health_detail{{check="{_label_escape(name)}",'
+                    f'severity="{chk.get("severity")}"}} 1')
     return "\n".join(out) + "\n"
 
 
@@ -101,6 +161,30 @@ def render_dashboard(status: dict, health: dict | None) -> str:
                       f"{esc(str(chk.get('summary')))}</li>")
     om = status.get("osdmap") or {}
     mods = esc(json.dumps(status.get("modules", {}), indent=1))
+    # per-daemon report table (the DaemonStateIndex view)
+    daemon_rows = []
+    for name, d in sorted((status.get("daemon_reports") or {}).items()):
+        daemon_rows.append(
+            f"<tr><td>{esc(str(name))}</td>"
+            f"<td>{esc(str(d.get('service', '')))}</td>"
+            f"<td>{esc(str(d.get('age_s', '')))}</td>"
+            f"<td>{esc(str(d.get('num_counters', '')))}</td></tr>")
+    daemons_html = ("<h2>daemons</h2><table><tr><th>daemon</th>"
+                    "<th>service</th><th>report age (s)</th>"
+                    "<th>counters</th></tr>"
+                    + "".join(daemon_rows) + "</table>"
+                    if daemon_rows else
+                    "<h2>daemons</h2><p>no daemon reports yet</p>")
+    progress_items = []
+    for ev in (status.get("progress_events")
+               or status.get("progress") or []):
+        frac = float(ev.get("progress", 0.0))
+        progress_items.append(
+            f"<li>{esc(str(ev.get('message', ev.get('id', '?'))))} "
+            f"[{esc(str(ev.get('daemon', '')))}]: {frac:.0%}</li>")
+    progress_html = ("<h2>progress</h2><ul>"
+                     + "".join(progress_items) + "</ul>"
+                     if progress_items else "")
     # recent traces (process-wide span collector; empty when tracing off)
     trace_rows = []
     for t in tracer.recent_traces(limit=15):
@@ -130,6 +214,8 @@ mons {', '.join(str(q) for q in
 <h2>pools</h2>
 <table><tr><th>pool</th><th>type</th><th>size</th><th>pg_num</th></tr>
 {''.join(rows)}</table>
+{daemons_html}
+{progress_html}
 {traces_html}
 <h2>mgr modules</h2><pre>{mods}</pre>
 <p><a href="/metrics">metrics</a> &middot;
@@ -144,10 +230,14 @@ class MetricsExporter:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  health_cb: Callable[[], Awaitable[dict]] | None = None,
-                 status_cb: Callable[[], Awaitable[dict]] | None = None):
+                 status_cb: Callable[[], Awaitable[dict]] | None = None,
+                 index=None):
         self.host, self.port = host, port
         self.health_cb = health_cb
         self.status_cb = status_cb
+        # the mgr's DaemonStateIndex: aggregated per-daemon counters
+        # from MMgrReport sessions (None -> local-registry fallback)
+        self.index = index
         self._server: asyncio.Server | None = None
         self.addr: tuple[str, int] | None = None
 
@@ -190,7 +280,7 @@ class MetricsExporter:
                 except Exception as e:
                     dout("mgr", 2, f"health callback failed: {e}")
             if path.startswith("/metrics"):
-                body = render_metrics(health).encode()
+                body = render_metrics(health, index=self.index).encode()
                 ctype = "text/plain; version=0.0.4"
                 code = "200 OK"
             elif path.startswith("/health"):

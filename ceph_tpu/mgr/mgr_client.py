@@ -1,0 +1,173 @@
+"""MgrClient: the daemon side of the mgr report fan-in.
+
+Re-creation of src/mgr/MgrClient.{h,cc}: every daemon (osd, mon, mds,
+rgw) holds a session to the active mgr and periodically ships an
+MMgrReport — its perf-counter schema once per session, then changed
+values only, plus a daemon_status blob, daemon health metrics (slow
+ops, pg states, store utilization), and in-flight progress events. The
+mgr aggregates these into its DaemonStateIndex (mgr/daemon.py), which
+the prometheus exporter renders with per-daemon labels.
+
+Discovery: the active mgr's address lives in the paxos-replicated
+mgrmap (mon/monitor.py MgrMonitor), pushed to "mgrmap" subscribers over
+the MonClient session (MMgrMap) — the caller-supplied `resolve` hook
+just reads that cache (never a command: polling the command plane from
+every daemon would load, and on ack timeouts churn, the shared mon
+session). Resolution only runs while the report session is down: an
+open connection is the liveness signal, and a dead mgr drops it,
+triggering a re-resolve against the latest pushed map.
+
+The session rides the daemon's existing messenger as a lossy client:
+reports are periodic and idempotent-by-merge, so a lost report costs
+one period of staleness, never correctness.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable
+
+from ceph_tpu.msg.messages import Message, MMgrConfigure, MMgrOpen, MMgrReport
+from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
+from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.perf_counters import PerfCountersCollection
+
+
+class MgrClient(Dispatcher):
+    """One daemon's reporting session to the active mgr."""
+
+    REPORT_PERIOD = 1.0         # mgr_tick_period analog; MMgrConfigure
+                                # from the mgr overrides it per session
+
+    def __init__(self, messenger: Messenger, daemon_name: str, service: str,
+                 resolve: Callable[[], "Awaitable | tuple | None"],
+                 status_cb: Callable[[], dict] | None = None,
+                 health_cb: Callable[[], dict] | None = None,
+                 progress_cb: Callable[[], list] | None = None,
+                 perf_name: str | None = None):
+        self.messenger = messenger
+        self.messenger.add_dispatcher(self)
+        self.daemon_name = daemon_name
+        self.service = service
+        self.resolve = resolve
+        self.status_cb = status_cb
+        self.health_cb = health_cb
+        self.progress_cb = progress_cb
+        self.perf_name = perf_name or daemon_name
+        self.period = self.REPORT_PERIOD
+        self.reports_sent = 0
+        self._conn: Connection | None = None
+        self._addr: tuple | None = None
+        self._schema_keys_sent: frozenset | None = None
+        self._last_sent: dict = {}
+        self._task: asyncio.Task | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(
+                self._report_loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            import contextlib
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+        if self._conn is not None:
+            await self._conn.close()
+            self._conn = None
+
+    # -- report loop ---------------------------------------------------------
+
+    async def _report_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.period)
+            try:
+                await self.send_report()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # a dead mgr must not wedge the daemon: drop the session
+                # and re-resolve next period
+                dout("mgrc", 5, f"{self.daemon_name}: report failed: "
+                               f"{type(e).__name__} {e}")
+                self._conn = None
+
+    async def _ensure_session(self) -> Connection | None:
+        if self._conn is not None and not self._conn._closed \
+                and self._conn.connected:
+            return self._conn
+        self._conn = None
+        addr = self.resolve()
+        if asyncio.iscoroutine(addr):
+            addr = await addr
+        if not addr:
+            return None
+        conn = await self.messenger.connect(
+            (addr[0], int(addr[1])), Policy.lossy_client())
+        conn.send_message(MMgrOpen(
+            {"daemon_name": self.daemon_name, "service": self.service}))
+        self._conn = conn
+        self._addr = tuple(addr)
+        # fresh session: the mgr's state for us may be gone — resend the
+        # schema and the full counter values
+        self._schema_keys_sent = None
+        self._last_sent = {}
+        return conn
+
+    def _safe(self, cb, default):
+        if cb is None:
+            return default
+        try:
+            return cb()
+        except Exception as e:
+            dout("mgrc", 5, f"{self.daemon_name}: report callback failed: "
+                           f"{type(e).__name__} {e}")
+            return default
+
+    async def send_report(self) -> bool:
+        """Build and ship one MMgrReport; False when no mgr is active."""
+        conn = await self._ensure_session()
+        if conn is None:
+            return False
+        payload: dict = {"daemon_name": self.daemon_name,
+                         "service": self.service, "stamp": time.time()}
+        pc = PerfCountersCollection.instance().get(self.perf_name)
+        if pc is not None:
+            schema = pc.schema()
+            keys = frozenset(schema)
+            if keys != self._schema_keys_sent:
+                # once per session — and again if the key set changed
+                # (daemon restart re-registered its counters)
+                payload["schema"] = schema
+                self._schema_keys_sent = keys
+                self._last_sent = {}
+            dump = pc.dump()
+            # deltas: only counters whose value moved since the last
+            # report travel; the mgr merges into its stored copy
+            payload["counters"] = {k: v for k, v in dump.items()
+                                   if self._last_sent.get(k) != v}
+            self._last_sent = dump
+        payload["daemon_status"] = self._safe(self.status_cb, {})
+        payload["health_metrics"] = self._safe(self.health_cb, {})
+        payload["progress"] = self._safe(self.progress_cb, [])
+        conn.send_message(MMgrReport(payload))
+        self.reports_sent += 1
+        return True
+
+    # -- dispatch ------------------------------------------------------------
+
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if isinstance(msg, MMgrConfigure):
+            period = msg.payload.get("period")
+            if period:
+                self.period = max(0.05, float(period))
+            return True
+        return False
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        if conn is self._conn:
+            self._conn = None

@@ -17,10 +17,10 @@ from __future__ import annotations
 import asyncio
 import time
 
-from ceph_tpu.msg.messages import (MLog, Message, MMonCommand,
+from ceph_tpu.msg.messages import (MLog, Message, MMgrMap, MMonCommand,
                                    MMonCommandAck, MMonGetMap, MMonMap,
-                                   MMonSubscribe, MOSDBoot, MOSDFailure,
-                                   MOSDMapMsg)
+                                   MMonMgrReport, MMonSubscribe, MOSDBoot,
+                                   MOSDFailure, MOSDMapMsg)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
 from ceph_tpu.utils.dout import dout
 
@@ -35,6 +35,9 @@ class MonClient(Dispatcher):
         self.messenger.add_dispatcher(self)
         self.mon_addrs = [tuple(a) for a in mon_addrs]
         self.monmap: dict | None = None
+        # latest pushed mgrmap (subscribe "mgrmap"): daemons resolve the
+        # active mgr from this cache, never by polling commands
+        self.mgrmap: dict | None = None
         self._conn: Connection | None = None
         self._cur_addr: tuple[str, int] | None = None
         self._tid = 0
@@ -172,6 +175,13 @@ class MonClient(Dispatcher):
         conn.send_message(MLog({"level": level, "who": who,
                                 "message": message, "stamp": time.time()}))
 
+    async def send_mgr_report(self, payload: dict) -> None:
+        """Ship the mgr's aggregated health digest to the mon
+        (MMonMgrReport; fire-and-forget like the osd plane — the next
+        tick re-sends a fresher digest anyway)."""
+        conn = await self._ensure_conn()
+        conn.send_message(MMonMgrReport(payload))
+
     async def close(self) -> None:
         self._closed = True
         for fut in self._waiters.values():
@@ -195,6 +205,13 @@ class MonClient(Dispatcher):
                 res = self.on_osdmap(msg.payload)
                 if asyncio.iscoroutine(res):
                     await res
+            return True
+        if isinstance(msg, MMgrMap):
+            m = msg.payload.get("mgrmap")
+            if m and (self.mgrmap is None or m.get("epoch", 0)
+                      >= self.mgrmap.get("epoch", 0)):
+                self.mgrmap = m
+                self.sub_got("mgrmap", m.get("epoch", 0))
             return True
         return False
 

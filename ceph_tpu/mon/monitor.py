@@ -32,13 +32,15 @@ import time
 from ceph_tpu.crush import CrushMap, Incremental, OSDMap, Pool, Rule, Step
 from ceph_tpu.mon.paxos import NotLeader, Paxos
 from ceph_tpu.mon.store import MonStore, MonStoreTxn
-from ceph_tpu.msg.messages import (MLog, Message, MMonCommand,
+from ceph_tpu.msg.messages import (MLog, Message, MMgrMap, MMonCommand,
                                    MMonCommandAck, MMonElection,
-                                   MMonGetMap, MMonMap, MMonPaxos,
-                                   MMonSubscribe, MOSDBoot, MOSDFailure,
-                                   MOSDMapMsg, MPing, MPingReply)
+                                   MMonGetMap, MMonMap, MMonMgrReport,
+                                   MMonPaxos, MMonSubscribe, MOSDBoot,
+                                   MOSDFailure, MOSDMapMsg, MPing,
+                                   MPingReply)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
 from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.perf_counters import PerfCountersCollection
 
 
 class MonMap:
@@ -354,6 +356,65 @@ class OSDMonitor:
         return changed
 
 
+class MgrMonitor:
+    """MgrMap service (src/mon/MgrMonitor.cc essentials): the active
+    mgr's identity + report address, replicated through paxos so every
+    quorum member — and any daemon asking `mgr dump` — agrees on where
+    reports go. Beacons keep it fresh; the leader drops an active mgr
+    whose beacons stop, which raises MGR_DOWN cluster-wide."""
+
+    BEACON_GRACE = 8.0          # mon_mgr_beacon_grace analog
+
+    def __init__(self, mon: "Monitor"):
+        self.mon = mon
+        self.map: dict = {"epoch": 0, "active_name": None,
+                          "active_addr": None}
+        self.last_beacon = 0.0      # monotonic; leader-local liveness
+
+    def load(self) -> None:
+        m = self.mon.store.get("mgrmap", "latest")
+        if m:
+            self.map = m
+
+    def beacon(self, name: str, addr) -> dict | None:
+        """Record a beacon; returns a new map to propose when the
+        active identity changed (first mgr, restart on a new port).
+        While an active mgr holds the slot, other mgrs' beacons are
+        STANDBY (ignored) — they take over only after the active is
+        dropped for beacon loss, like the reference's standby pool."""
+        addr = list(addr) if addr else None
+        active = self.map.get("active_name")
+        if active is not None and active != name:
+            return None
+        self.last_beacon = time.monotonic()
+        if active == name and self.map.get("active_addr") == addr:
+            return None
+        return {"epoch": self.map.get("epoch", 0) + 1,
+                "active_name": name, "active_addr": addr}
+
+    def tick(self) -> dict | None:
+        """Leader periodic work: drop an active mgr whose beacons
+        stopped (returns the map to propose)."""
+        if not self.map.get("active_name"):
+            return None
+        if not self.last_beacon:
+            # fresh leadership: grant a full grace window before
+            # declaring the recorded active mgr dead
+            self.last_beacon = time.monotonic()
+            return None
+        if time.monotonic() - self.last_beacon > self.BEACON_GRACE:
+            return {"epoch": self.map.get("epoch", 0) + 1,
+                    "active_name": None, "active_addr": None}
+        return None
+
+    def apply_commit(self, m: dict, txn: MonStoreTxn) -> None:
+        if m.get("epoch", 0) <= self.map.get("epoch", 0):
+            return
+        self.map = m
+        txn.put("mgrmap", "latest", m)
+        self.mon.push_mgrmap()
+
+
 class Monitor(Dispatcher):
     """One monitor daemon: messenger + paxos + services + client plane."""
 
@@ -373,8 +434,21 @@ class Monitor(Dispatcher):
                            on_role_change=self._on_role_change)
         self.paxos.on_sync = self._on_store_sync
         self.osdmon = OSDMonitor(self)
+        self.mgrmon = MgrMonitor(self)
+        # mgr-fed health digest (MMonMgrReport): checks + progress +
+        # per-daemon report ages, merged into the health engine while
+        # fresh
+        self.mgr_digest: dict | None = None
+        self._mgr_digest_mono = 0.0
+        # health mutes: code -> {"expires": wall|None, "stamp": wall};
+        # persisted through the mon store so a restart keeps them
+        self.health_mutes: dict[str, dict] = {}
+        self._prev_checks: dict[str, str] = {}   # code -> severity
         # osdmap subscribers: conn -> next epoch wanted
         self.subs: dict[Connection, int] = {}
+        # mgrmap subscribers: conn -> next epoch wanted (daemons learn
+        # the active mgr by push, never by polling commands)
+        self.mgr_subs: dict[Connection, int] = {}
         self._tick_task: asyncio.Task | None = None
         self._applied = 0      # last paxos version applied to services
         # cluster log (LogMonitor-lite, src/mon/LogMonitor.cc): WARN+
@@ -382,16 +456,43 @@ class Monitor(Dispatcher):
         # events, in a bounded ring queryable via `log last`
         self.cluster_log: collections.deque[dict] = \
             collections.deque(maxlen=1000)
+        # per-daemon perf counters: quorum/paxos activity, shipped to
+        # the mgr like every other daemon's
+        coll = PerfCountersCollection.instance()
+        coll.remove(f"mon.{name}")      # a restarted mon re-registers
+        self.perf = coll.create(f"mon.{name}")
+        self.perf.add("paxos_commit", description="paxos values committed")
+        self.perf.add("election", description="elections called")
+        self.perf.add("command", description="mon commands served")
+        self.perf.add("cluster_log_lines",
+                      description="cluster-log lines recorded")
+        self.paxos.perf = self.perf
+        # report session to the active mgr (resolved from the replicated
+        # mgrmap — every mon, leader or peon, knows it). Lazy import:
+        # ceph_tpu.mgr pulls in mon_client, which would cycle here.
+        from ceph_tpu.mgr.mgr_client import MgrClient
+        self.mgr_client = MgrClient(
+            self.messenger, f"mon.{name}", "mon",
+            resolve=lambda: self.mgrmon.map.get("active_addr"),
+            status_cb=lambda: {
+                "rank": self.rank, "leader": self.paxos.is_leader(),
+                "quorum": sorted(self.paxos.quorum),
+                "osdmap_epoch": self.osdmon.osdmap.epoch,
+                "applied_version": self._applied},
+            perf_name=f"mon.{name}")
 
     # -- lifecycle -----------------------------------------------------------
 
     async def start(self) -> tuple[str, int]:
         addr = await self.messenger.bind(*self.monmap.mons[self.name])
         self.osdmon.load()
+        self.mgrmon.load()
+        self.health_mutes = self.store.get("health", "mutes", {}) or {}
         self._applied = self.store.get("mon", "applied_version", 0)
         self.paxos.recover_from_store()
         self._replay_missing()
         await self.paxos.start()
+        self.mgr_client.start()
         self._tick_task = asyncio.get_running_loop().create_task(self._tick())
         dout("mon", 1, f"mon.{self.name} up at {addr} rank {self.rank}")
         return addr
@@ -403,6 +504,7 @@ class Monitor(Dispatcher):
                 await self._tick_task
             except (asyncio.CancelledError, Exception):
                 pass
+        await self.mgr_client.stop()
         await self.paxos.stop()
         await self.messenger.shutdown()
 
@@ -421,6 +523,10 @@ class Monitor(Dispatcher):
                 if self.paxos.is_leader() and self.paxos.is_active():
                     if self.osdmon.tick():
                         await self.osdmon.propose_pending()
+                    m = self.mgrmon.tick()
+                    if m is not None:
+                        await self._propose_mgrmap(m)
+                    self._log_health_transitions()
             except asyncio.CancelledError:
                 raise
             except Exception as e:
@@ -429,6 +535,17 @@ class Monitor(Dispatcher):
                 # on the next tick
                 dout("mon", 5, f"mon.{self.name}: tick proposal failed: "
                                f"{type(e).__name__} {e}")
+
+    async def _propose_mgrmap(self, m: dict) -> None:
+        value = json.dumps({"service": "mgrmap", "map": m}).encode()
+        await asyncio.wait_for(self.paxos.propose(value), 30)
+
+    async def _propose_health_mutes(self, mutes: dict) -> None:
+        """Mute set/clear rides paxos so every quorum member answers
+        `health` identically and mutes survive leadership changes."""
+        value = json.dumps({"service": "health",
+                            "mutes": mutes}).encode()
+        await asyncio.wait_for(self.paxos.propose(value), 30)
 
     # -- paxos plumbing ------------------------------------------------------
 
@@ -441,6 +558,11 @@ class Monitor(Dispatcher):
             decoded = json.loads(value)
             if decoded.get("service") == "osdmap":
                 self.osdmon.apply_commit(decoded["inc"], txn)
+            elif decoded.get("service") == "mgrmap":
+                self.mgrmon.apply_commit(decoded["map"], txn)
+            elif decoded.get("service") == "health":
+                self.health_mutes = decoded.get("mutes", {}) or {}
+                txn.put("health", "mutes", self.health_mutes)
         except Exception as e:
             dout("mon", 0, f"mon.{self.name}: apply v{version} failed: "
                            f"{type(e).__name__} {e}")
@@ -455,11 +577,18 @@ class Monitor(Dispatcher):
         self.osdmon.down_at.clear()
         self.osdmon.failure_reports.clear()
         self.osdmon.load()
+        self.mgrmon.load()
+        self.health_mutes = self.store.get("health", "mutes", {}) or {}
         self._applied = self.store.get("mon", "applied_version", 0)
         dout("mon", 1, f"mon.{self.name}: full sync -> osdmap epoch "
                        f"{self.osdmon.osdmap.epoch}")
 
     def _on_role_change(self) -> None:
+        if self.paxos.is_leader():
+            # beacons landed on the previous leader while we were a
+            # peon: re-arm the grace window instead of dropping a live
+            # active mgr on our stale clock
+            self.mgrmon.last_beacon = 0.0
         if self.paxos.is_leader() and self.osdmon.osdmap.epoch == 0:
             # first leader seeds the initial map (epoch 1: empty crush root)
             crush = CrushMap()
@@ -502,6 +631,25 @@ class Monitor(Dispatcher):
             p = msg.payload
             self.clog(p.get("level", "WRN"), p.get("who", "?"),
                       p.get("message", ""), stamp=p.get("stamp"))
+        elif isinstance(msg, MMonMgrReport):
+            # only the ACTIVE mgr's digest counts: a just-demoted mgr
+            # whose fire-and-forget sends are still in flight must not
+            # clobber its successor's fresher digest
+            sender = msg.payload.get("from")
+            if sender is not None and \
+                    sender != self.mgrmon.map.get("active_name"):
+                return True
+            self.mgr_digest = msg.payload
+            self._mgr_digest_mono = time.monotonic()
+            # the health engine runs wherever `health` is asked: forward
+            # so the leader (and through it, transitions -> clog) always
+            # has the freshest digest even when the mgr's session landed
+            # on a peon
+            if not self.paxos.is_leader():
+                leader = self.paxos.leader
+                if leader is not None and leader != self.rank:
+                    await self.paxos._send(
+                        leader, MMonMgrReport(dict(msg.payload)))
         else:
             return False
         return True
@@ -515,10 +663,12 @@ class Monitor(Dispatcher):
         self.cluster_log.append(
             {"stamp": stamp if stamp is not None else time.time(),
              "level": level, "who": who, "message": message})
+        self.perf.inc("cluster_log_lines")
         dout("mon", 2, f"mon.{self.name} clog [{level}] {who}: {message}")
 
     def ms_handle_reset(self, conn: Connection) -> None:
         self.subs.pop(conn, None)
+        self.mgr_subs.pop(conn, None)
 
     # -- client plane --------------------------------------------------------
 
@@ -538,10 +688,28 @@ class Monitor(Dispatcher):
             start = int(want["osdmap"])
             self.subs[conn] = start
             self._push_maps(conn)
+        if "mgrmap" in want:
+            self.mgr_subs[conn] = int(want["mgrmap"])
+            self._push_mgrmap(conn)
 
     def kick_subscribers(self) -> None:
         for conn in list(self.subs):
             self._push_maps(conn)
+
+    def push_mgrmap(self) -> None:
+        for conn in list(self.mgr_subs):
+            self._push_mgrmap(conn)
+
+    def _push_mgrmap(self, conn: Connection) -> None:
+        epoch = self.mgrmon.map.get("epoch", 0)
+        if epoch < self.mgr_subs.get(conn, 0):
+            return
+        try:
+            conn.send_message(MMgrMap({"mgrmap": dict(self.mgrmon.map)}))
+        except Exception:
+            self.mgr_subs.pop(conn, None)
+            return
+        self.mgr_subs[conn] = epoch + 1
 
     def _push_maps(self, conn: Connection) -> None:
         start = self.subs.get(conn, 0)
@@ -584,10 +752,15 @@ class Monitor(Dispatcher):
         tid = msg.payload.get("tid", 0)
         cmd = msg.payload.get("cmd", {})
         prefix = cmd.get("prefix", "")
+        self.perf.inc("command")
+        # `health`/`health detail`/`status` are leader-routed (NOT
+        # read-only): the mgr digest and mute state live with the
+        # leader, and a peon answering from local state would hide
+        # SLOW_OPS, a mute, or in-flight progress
         read_only = prefix in ("mon stat", "osd dump", "osd tree",
                                "osd erasure-code-profile ls",
                                "osd erasure-code-profile get",
-                               "status", "health", "log last")
+                               "mgr dump", "log last")
         if not read_only and not (self.paxos.is_leader()
                                   and self.paxos.is_active()):
             conn.send_message(self._retry_ack(tid, "not leader"))
@@ -616,10 +789,14 @@ class Monitor(Dispatcher):
              "leader_addr": (list(self.monmap.addr_of_rank(leader))
                              if leader is not None else None)})
 
-    def _health_checks(self) -> dict:
-        """HEALTH_OK/WARN/ERR with per-check detail (the reference's
-        health_check_map_t, src/mon/health_check.h; checks modeled on
-        OSD_DOWN / OSD_OUT_OF_QUORUM / POOL levels)."""
+    # -- health engine (health_check_map_t, src/mon/health_check.h) ----------
+
+    DIGEST_STALE = 15.0         # ignore a mgr digest older than this
+
+    def _raw_health_checks(self) -> dict[str, dict]:
+        """The full check map: local map-derived checks + mgr-fed checks
+        (SLOW_OPS, PG_DEGRADED/UNDERSIZED, OSD_NEARFULL/FULL) while the
+        digest is fresh. Mutes are applied by the caller."""
         om = self.osdmon
         checks: dict[str, dict] = {}
         down = [i for i, st in om.osdmap.osds.items() if not st.up]
@@ -653,32 +830,138 @@ class Monitor(Dispatcher):
                     "detail": []})["detail"].append(
                     f"pool {pool.name!r} needs {pool.min_size} "
                     f"up osds, have {up_osds}")
-        if any(c["severity"] == "HEALTH_ERR" for c in checks.values()):
+        # MGR_DOWN: a mgr was active (mgrmap epoch moved) but none is
+        # now — daemon reports and labeled metrics have stopped. A
+        # cluster that never ran a mgr stays clean.
+        if self.mgrmon.map.get("epoch", 0) > 0 \
+                and not self.mgrmon.map.get("active_name"):
+            checks["MGR_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "summary": "no active mgr (daemon reports stopped)"}
+        if self.mgr_digest is not None and self._mgr_digest_mono and \
+                time.monotonic() - self._mgr_digest_mono \
+                < self.DIGEST_STALE:
+            for code, chk in (self.mgr_digest.get("checks")
+                              or {}).items():
+                checks.setdefault(str(code), dict(chk))
+        return checks
+
+    def _active_mutes(self) -> dict[str, dict]:
+        """Prune expired mutes (TTL), persisting the change."""
+        now = time.time()
+        expired = [c for c, m in self.health_mutes.items()
+                   if m.get("expires") and now >= m["expires"]]
+        for code in expired:
+            del self.health_mutes[code]
+            self.clog("WRN", f"mon.{self.name}",
+                      f"health mute {code} expired")
+        if expired:
+            self.store.put_one("health", "mutes", self.health_mutes)
+        return self.health_mutes
+
+    def _health_checks(self, detail: bool = False) -> dict:
+        """HEALTH_OK/WARN/ERR from the unmuted check map; muted checks
+        are excluded from the summary status but reported under
+        "muted" (fully, in `health detail`)."""
+        checks = self._raw_health_checks()
+        mutes = self._active_mutes()
+        visible = {c: chk for c, chk in checks.items() if c not in mutes}
+        if any(c["severity"] == "HEALTH_ERR" for c in visible.values()):
             status = "HEALTH_ERR"
-        elif checks:
+        elif visible:
             status = "HEALTH_WARN"
         else:
             status = "HEALTH_OK"
-        return {"status": status, "checks": checks}
+        muted = {}
+        for code, mute in mutes.items():
+            entry = {"expires_in_s":
+                     (round(mute["expires"] - time.time(), 1)
+                      if mute.get("expires") else None)}
+            if detail and code in checks:
+                entry.update(checks[code])
+            muted[code] = entry
+        return {"status": status, "checks": visible, "muted": muted}
+
+    def _log_health_transitions(self) -> None:
+        """WARN+ check transitions land in the cluster log (the
+        reference LogMonitor's `Health check failed:` lines)."""
+        checks = self._raw_health_checks()
+        for code, chk in checks.items():
+            sev = chk.get("severity", "HEALTH_WARN")
+            if self._prev_checks.get(code) != sev:
+                self.clog("ERR" if sev == "HEALTH_ERR" else "WRN",
+                          f"mon.{self.name}",
+                          f"Health check failed: "
+                          f"{chk.get('summary')} ({code})")
+        for code in self._prev_checks:
+            if code not in checks:
+                self.clog("INF", f"mon.{self.name}",
+                          f"Health check cleared: {code}")
+        self._prev_checks = {c: chk.get("severity", "HEALTH_WARN")
+                             for c, chk in checks.items()}
 
     async def _run_command(self, prefix: str, cmd: dict) -> dict:
         om = self.osdmon
         if prefix == "health":
             return self._health_checks()
+        if prefix == "health detail":
+            return self._health_checks(detail=True)
+        if prefix == "health mute":
+            code = cmd["code"]
+            ttl = cmd.get("ttl")
+            mutes = dict(self.health_mutes)
+            mutes[code] = {
+                "stamp": time.time(),
+                "expires": time.time() + float(ttl) if ttl else None}
+            await self._propose_health_mutes(mutes)
+            self.clog("WRN", f"mon.{self.name}",
+                      f"health check {code} muted"
+                      + (f" for {float(ttl):.0f}s" if ttl else ""))
+            return {"muted": code, "ttl": ttl}
+        if prefix == "health unmute":
+            existed = cmd["code"] in self.health_mutes
+            if existed:
+                mutes = dict(self.health_mutes)
+                del mutes[cmd["code"]]
+                await self._propose_health_mutes(mutes)
+            return {"unmuted": cmd["code"], "existed": existed}
+        if prefix == "mgr dump":
+            out = dict(self.mgrmon.map)
+            digest = self.mgr_digest or {}
+            out["daemons"] = digest.get("daemons", {})
+            out["digest_age_s"] = (
+                round(time.monotonic() - self._mgr_digest_mono, 2)
+                if self._mgr_digest_mono else None)
+            return out
+        if prefix == "mgr beacon":
+            new_map = self.mgrmon.beacon(cmd.get("name", "?"),
+                                         cmd.get("addr"))
+            if new_map is not None:
+                await self._propose_mgrmap(new_map)
+                self.clog("WRN", f"mon.{self.name}",
+                          f"mgr.{cmd.get('name', '?')} is now active")
+            # the reply names the active mgr: a standby learns its role
+            # from this and keeps its digest to itself
+            return {"epoch": self.mgrmon.map.get("epoch", 0),
+                    "active_name": self.mgrmon.map.get("active_name")}
         if prefix == "status":
-            # `ceph -s` analog: health + mon + osd + pool summary
+            # `ceph -s` analog: health + mon + mgr + osd + pool summary
             up = sum(1 for st in om.osdmap.osds.values() if st.up)
+            digest = self.mgr_digest or {}
             return {
                 "health": self._health_checks(),
                 "monmap": {"mons": sorted(self.monmap.mons),
                            "quorum": sorted(self.paxos.quorum),
                            "leader": self.paxos.leader},
+                "mgrmap": {"active": self.mgrmon.map.get("active_name"),
+                           "epoch": self.mgrmon.map.get("epoch", 0)},
                 "osdmap": {"epoch": om.osdmap.epoch,
                            "num_osds": len(om.osdmap.osds),
                            "num_up_osds": up},
                 "pools": {p.name: {"type": p.type, "size": p.size,
                                    "pg_num": p.pg_num}
                           for p in om.osdmap.pools.values()},
+                "progress": digest.get("progress", []),
             }
         if prefix == "log last":
             n = int(cmd.get("num", 20))

@@ -53,6 +53,7 @@ class Paxos:
         self.on_commit = on_commit             # (version, value) in order
         self.on_role_change = on_role_change or (lambda: None)
         self.on_sync: Callable[[], None] | None = None  # after sync_full
+        self.perf = None           # hosting mon's PerfCounters, if any
 
         # durable state
         self.last_pn = store.get("paxos", "last_pn", 0)
@@ -157,6 +158,8 @@ class Paxos:
     def start_election(self) -> None:
         self.role = "electing"
         self._active = False
+        if self.perf is not None:
+            self.perf.inc("election")
         self.epoch += 1 if self.epoch % 2 == 0 else 2
         self.store.put_one("paxos", "election_epoch", self.epoch)
         self._election_acks = {self.rank}
@@ -374,6 +377,8 @@ class Paxos:
         self.store.apply_transaction(txn)
         self.last_committed = version
         self.uncommitted = None
+        if self.perf is not None:
+            self.perf.inc("paxos_commit")
         self.on_commit(version, value)
 
     def _extend_lease(self) -> None:

@@ -161,6 +161,32 @@ MClientRequest = _simple(0xA0, "MClientRequest")    # {"tid", "op", "path",
                                                     #  ...op args}
 MClientReply = _simple(0xA1, "MClientReply")        # {"tid", "rc", "out"}
 
+# -- mgr report fan-in (MMgrOpen/MMgrConfigure/MMgrReport,
+# src/messages/MMgrOpen.h, MMgrConfigure.h, MMgrReport.h) --------------------
+MMgrOpen = _simple(0xB0, "MMgrOpen")          # daemon -> mgr session open:
+                                              # {"daemon_name": "osd.0",
+                                              #  "service": "osd"}
+MMgrConfigure = _simple(0xB1, "MMgrConfigure")  # mgr -> daemon: {"period": s}
+MMgrReport = _simple(0xB2, "MMgrReport")      # daemon -> mgr periodic:
+                                              # {"daemon_name", "service",
+                                              #  "schema": {...}|null (once
+                                              #  per session), "counters":
+                                              #  changed-key deltas,
+                                              #  "daemon_status": {...},
+                                              #  "health_metrics": {...},
+                                              #  "progress": [...], "stamp"}
+MMonMgrReport = _simple(0xB3, "MMonMgrReport")  # mgr -> mon aggregated digest
+                                                # (src/messages/MMonMgrReport
+                                                # .h): {"checks": {...},
+                                                #  "progress": [...],
+                                                #  "daemons": {name: age}}
+MMgrMap = _simple(0xB4, "MMgrMap")              # mon -> subscriber push of the
+                                                # replicated mgrmap
+                                                # (src/messages/MMgrMap.h):
+                                                # {"mgrmap": {"epoch",
+                                                #  "active_name",
+                                                #  "active_addr"}}
+
 # -- scrub (MOSDRepScrub / replica scrub map, src/messages/MOSDRepScrub.h) ---
 MOSDRepScrub = _simple(0x80, "MOSDRepScrub")        # {"pgid", "tid", "from",
                                                     #  "deep": bool}

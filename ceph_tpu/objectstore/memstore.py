@@ -49,6 +49,7 @@ class MemStore(ObjectStore):
         self._colls: dict[CollectionId, dict[Ghobject, _Object]] = {}
         self._lock = threading.RLock()
         self._mounted = False
+        self._used_cache: tuple[float, int] | None = None
         self.perf = PerfCounters(f"memstore:{name}")
         self.perf.add("ops")
         self.perf.add("txns")
@@ -65,6 +66,24 @@ class MemStore(ObjectStore):
 
     def umount(self) -> None:
         self._mounted = False
+
+    #: statfs calls land once per mgr report period; a full O(objects)
+    #: rescan under the store lock each time would stall commits on a
+    #: bench-scale store, so the answer is cached briefly — NEARFULL
+    #: thresholds tolerate seconds of staleness
+    USED_BYTES_TTL = 2.0
+
+    def used_bytes(self) -> int:
+        now = time.monotonic()
+        cached = self._used_cache
+        if cached is not None and now - cached[0] < self.USED_BYTES_TTL:
+            return cached[1]
+        with self._lock:
+            used = sum(len(obj.data)
+                       for coll in self._colls.values()
+                       for obj in coll.values())
+        self._used_cache = (now, used)
+        return used
 
     # -- lookup helpers ------------------------------------------------------
 

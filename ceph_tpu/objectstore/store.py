@@ -198,6 +198,22 @@ class ObjectStore:
         if impl is not None and not getattr(impl, "_observed", False):
             cls.queue_transaction = _observed_txn(impl)
 
+    #: nominal device size for utilization reporting (statfs); daemons
+    #: report used/capacity to the mgr, which drives OSD_NEARFULL/FULL
+    capacity_bytes = 1 << 30
+
+    def statfs(self) -> dict:
+        """Space accounting (ObjectStore::statfs). Backends that can
+        measure override `used_bytes`; the base answer keeps health
+        reporting total-ordered even for stores that cannot."""
+        used = self.used_bytes()
+        cap = self.capacity_bytes
+        return {"used_bytes": used, "capacity_bytes": cap,
+                "utilization": round(used / cap, 4) if cap else 0.0}
+
+    def used_bytes(self) -> int:
+        return 0
+
     # lifecycle
     def mkfs(self) -> None:
         raise NotImplementedError

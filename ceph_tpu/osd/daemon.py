@@ -21,6 +21,7 @@ import json
 import time
 
 from ceph_tpu.crush.osdmap import PG, Incremental, OSDMap
+from ceph_tpu.mgr.mgr_client import MgrClient
 from ceph_tpu.msg.messages import (Message, MOSDOp, MOSDOpReply, MOSDPGInfo,
                                    MOSDPGLog, MOSDPGPush, MOSDPGPushReply,
                                    MOSDPGQuery, MOSDRepOp, MOSDRepOpReply,
@@ -151,17 +152,21 @@ class OSD(Dispatcher):
                              if pg.last_scrub is not None},
                 "last scrub result per PG")
             self.asok.register_command(
-                "status", lambda req: {
-                    "whoami": self.whoami,
-                    "osdmap_epoch": self.osdmap.epoch,
-                    "num_pgs": len(self.pgs),
-                    "hb_healthy": self.hb_map.is_healthy()[0],
-                    "ops_processed": self.op_queue.processed},
+                "status", lambda req: self._daemon_status(),
                 "daemon status")
         self.messenger = Messenger(f"osd.{whoami}", auth_key=auth_key)
         self.messenger.add_dispatcher(self)
         self.monc = MonClient(self.messenger, mon_addrs)
         self.monc.on_osdmap = self._on_osdmap
+        # mgr report session: perf-counter deltas + daemon status +
+        # health metrics (slow ops, pg states, store utilization) +
+        # recovery progress, shipped as MMgrReport over the messenger
+        self.mgr_client = MgrClient(
+            self.messenger, f"osd.{whoami}", "osd",
+            resolve=lambda: (self.monc.mgrmap or {}).get("active_addr"),
+            status_cb=self._daemon_status,
+            health_cb=self._mgr_health_metrics,
+            progress_cb=self._mgr_progress)
         self.osdmap = OSDMap()
         self.pgs: dict[PG, PGInstance] = {}
         self.addr: tuple[str, int] | None = None
@@ -212,6 +217,7 @@ class OSD(Dispatcher):
         self.addr = await self.messenger.bind("127.0.0.1", 0)
         await self.monc.start()
         self.monc.subscribe("osdmap", 1)
+        self.monc.subscribe("mgrmap", 1)
         await self.monc.send_boot(self.whoami, self.addr,
                                   crush_location=self.crush_location)
         deadline = time.monotonic() + timeout
@@ -228,8 +234,57 @@ class OSD(Dispatcher):
             self._heartbeat())
         self._scrub_task = asyncio.get_running_loop().create_task(
             self._scrub_loop())
+        self.mgr_client.start()
         dout("osd", 1, f"osd.{self.whoami} up at {self.addr}")
         return self.addr
+
+    # -- mgr reporting -------------------------------------------------------
+
+    def _daemon_status(self) -> dict:
+        return {"whoami": self.whoami,
+                "osdmap_epoch": self.osdmap.epoch,
+                "num_pgs": len(self.pgs),
+                "hb_healthy": self.hb_map.is_healthy()[0],
+                "ops_processed": self.op_queue.processed}
+
+    def _mgr_health_metrics(self) -> dict:
+        """Daemon health metrics for the report path: slow ops from the
+        OpTracker, pending PG states, store utilization — the inputs of
+        the mon's SLOW_OPS / PG_* / OSD_NEARFULL checks."""
+        slow = self.optracker.get_health_metrics()
+        states: dict[str, int] = {}
+        degraded = undersized = 0
+        for pg in self.pgs.values():
+            states[pg.state] = states.get(pg.state, 0) + 1
+            if not pg.is_primary():
+                continue
+            if len(pg.acting) < pg.pool.size:
+                undersized += 1
+                degraded += 1
+            elif pg._pending_recovery:
+                degraded += 1
+        return {"slow_ops": slow["slow_ops"],
+                "slow_ops_oldest_age_s": slow["oldest_age_s"],
+                "pg_states": states,
+                "degraded_pgs": degraded,
+                "undersized_pgs": undersized,
+                "store": self.store.statfs()}
+
+    def _mgr_progress(self) -> list:
+        """Completion fractions for in-flight recovery/backfill (the
+        reference progress module's events, fed through MMgrReport)."""
+        out = []
+        for pg in self.pgs.values():
+            total = getattr(pg, "recovery_total", 0)
+            remaining = len(pg._pending_recovery)
+            if total and remaining:
+                out.append({
+                    "id": f"recovery-{pg.pgid.pool}.{pg.pgid.ps}",
+                    "message": f"recovery of pg "
+                               f"{pg.pgid.pool}.{pg.pgid.ps}",
+                    "progress": round(
+                        max(0.0, (total - remaining)) / total, 4)})
+        return out
 
     def _trigger_scrub(self, deep: bool) -> dict:
         n = 0
@@ -312,6 +367,7 @@ class OSD(Dispatcher):
         await self.finisher.stop()
         if self.asok is not None:
             self.asok.stop()
+        await self.mgr_client.stop()
         await self.monc.close()
         await self.messenger.shutdown()
         self.store.umount()
@@ -619,24 +675,8 @@ class OSD(Dispatcher):
             trk = self.optracker.create(desc)
             trk.trace = tracer.current_context()
             trk.mark_event("detached_notify")
-
-            async def run_notify():
-                token = set_current_op(trk)
-                t0 = time.monotonic()
-                try:
-                    with tracer.span("osd_op", f"osd.{self.whoami}",
-                                     parent=trk.trace) as sp:
-                        if sp is not None:
-                            sp.set_tag("desc", trk.description)
-                        await self._handle_op(conn, msg)
-                finally:
-                    reset_current_op(token)
-                    trk.finish()
-                    self.perf.inc("op")
-                    lat = time.monotonic() - t0
-                    self.perf.avg_add("op_latency", lat)
-                    self.perf.hist_add("op_total_us", lat * 1e6)
-            t = asyncio.get_running_loop().create_task(run_notify())
+            t = asyncio.get_running_loop().create_task(
+                self._execute_op(conn, msg, trk))
             self._notify_tasks.add(t)
             t.add_done_callback(self._notify_tasks.discard)
             return
@@ -659,6 +699,29 @@ class OSD(Dispatcher):
         waiting = self._waiting_for_active.setdefault(pgid, [])
         bisect.insort(waiting, (seq, conn, msg, trk), key=lambda e: e[0])
 
+    async def _execute_op(self, conn: Connection, msg: MOSDOp, trk,
+                          queue_wait_us: float | None = None) -> None:
+        """Run one tracked client op with its span + perf accounting —
+        the single site for op latency bookkeeping (detached notifies
+        and queued ops both land here)."""
+        token = set_current_op(trk)
+        t0 = time.monotonic()
+        try:
+            with tracer.span("osd_op", f"osd.{self.whoami}",
+                             parent=trk.trace) as sp:
+                if sp is not None:
+                    sp.set_tag("desc", trk.description)
+                    if queue_wait_us is not None:
+                        sp.set_tag("queue_wait_us", queue_wait_us)
+                await self._handle_op(conn, msg)
+        finally:
+            reset_current_op(token)
+            trk.finish()
+            self.perf.inc("op")
+            lat = time.monotonic() - t0
+            self.perf.avg_add("op_latency", lat)
+            self.perf.hist_add("op_total_us", lat * 1e6)
+
     def _enqueue_op(self, pgid: PG, seq: int, conn: Connection,
                     msg: MOSDOp, trk) -> None:
         t_enq = time.monotonic()
@@ -672,25 +735,10 @@ class OSD(Dispatcher):
                 self._park_op(pgid, seq, conn, msg, trk)
                 return
             trk.mark_event("dequeued")
-            self.perf.hist_add("op_queue_wait_us",
-                               (time.monotonic() - t_enq) * 1e6)
-            token = set_current_op(trk)
-            t0 = time.monotonic()
-            try:
-                with tracer.span("osd_op", f"osd.{self.whoami}",
-                                 parent=trk.trace) as sp:
-                    if sp is not None:
-                        sp.set_tag("desc", trk.description)
-                        sp.set_tag("queue_wait_us",
-                                   round((t0 - t_enq) * 1e6, 1))
-                    await self._handle_op(conn, msg)
-            finally:
-                reset_current_op(token)
-                trk.finish()
-                self.perf.inc("op")
-                lat = time.monotonic() - t0
-                self.perf.avg_add("op_latency", lat)
-                self.perf.hist_add("op_total_us", lat * 1e6)
+            wait_us = (time.monotonic() - t_enq) * 1e6
+            self.perf.hist_add("op_queue_wait_us", wait_us)
+            await self._execute_op(conn, msg, trk,
+                                   queue_wait_us=round(wait_us, 1))
         self.op_queue.enqueue((pgid.pool, pgid.ps), work)
 
     def requeue_waiting(self, pg: PGInstance) -> None:

@@ -75,6 +75,10 @@ class PGInstance:
         # async recovery: oid -> behind peers still needing a push;
         # activation of those peers is deferred until their set drains
         self._pending_recovery: dict[str, set[int]] = {}
+        # objects in the current recovery round at its start — with
+        # len(_pending_recovery) remaining, this yields the completion
+        # fraction published through the mgr report path
+        self.recovery_total = 0
         self._deferred_activate: dict[int, dict] = {}
         self._recovery_inflight: dict[str, asyncio.Future] = {}
         self._recovery_task: asyncio.Task | None = None
@@ -290,6 +294,7 @@ class PGInstance:
             self._snaptrim_task.cancel()
         self._snaptrim_task = None
         self._pending_recovery.clear()
+        self.recovery_total = 0
         self._deferred_activate.clear()
         for fut in self._peer_waiters.values():
             if not fut.done():
@@ -450,6 +455,7 @@ class PGInstance:
                  "from": self.host.whoami,
                  "missing": {o: list(self.log.head) for o in need_oids}}))
         self._pending_recovery = pending
+        self.recovery_total = len(pending)
         self._deferred_activate = deferred
         self.last_epoch_started = epoch
         self.persist_meta()

@@ -37,11 +37,14 @@ from __future__ import annotations
 import asyncio
 import json
 import secrets
+import time
 from urllib.parse import parse_qs, unquote, urlsplit
 from xml.sax.saxutils import escape
 
+from ceph_tpu.mgr.mgr_client import MgrClient
 from ceph_tpu.rados.client import IoCtx, ObjectNotFound
 from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.perf_counters import TYPE_AVG, PerfCountersCollection
 
 
 def _index_oid(bucket: str) -> str:
@@ -59,21 +62,49 @@ class RGWGateway:
     placement-target data_pool split (rgw zone placement pools)."""
 
     def __init__(self, ioctx: IoCtx, host: str = "127.0.0.1",
-                 port: int = 0, data_ioctx: IoCtx | None = None):
+                 port: int = 0, data_ioctx: IoCtx | None = None,
+                 name: str = "rgw.0"):
         self.io = ioctx
         self.data_io = data_ioctx if data_ioctx is not None else ioctx
         self.host, self.port = host, port
+        self.name = name
         self._server: asyncio.Server | None = None
         self.addr: tuple[str, int] | None = None
+        # per-daemon perf counters (src/rgw/rgw_perf_counters.cc: req,
+        # op breakdown, byte counters), shipped to the mgr over the
+        # backing RADOS client's messenger
+        coll = PerfCountersCollection.instance()
+        coll.remove(name)               # a restarted gateway re-registers
+        self.perf = coll.create(name)
+        self.perf.add("req", description="http requests processed")
+        self.perf.add("op_get", description="object GET/HEAD ops")
+        self.perf.add("op_put", description="object PUT ops")
+        self.perf.add("op_del", description="object/bucket DELETE ops")
+        self.perf.add("bytes_received",
+                      description="request body bytes received")
+        self.perf.add("bytes_sent", description="response bytes sent")
+        self.perf.add("req_latency", type=TYPE_AVG,
+                      description="request latency (seconds)")
+        self.mgr_client = MgrClient(
+            ioctx.client.messenger, name, "rgw",
+            resolve=lambda: (ioctx.client.monc.mgrmap
+                             or {}).get("active_addr"),
+            status_cb=lambda: {
+                "index_pool": self.io.pool_name,
+                "data_pool": self.data_io.pool_name,
+                "addr": list(self.addr) if self.addr else None})
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port)
         self.addr = self._server.sockets[0].getsockname()[:2]
+        self.io.client.monc.subscribe("mgrmap", 1)
+        self.mgr_client.start()
         dout("rgw", 1, f"rgw-lite on {self.addr}")
         return self.addr
 
     async def stop(self) -> None:
+        await self.mgr_client.stop()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -108,7 +139,7 @@ class RGWGateway:
                 body = b""
             else:
                 body = await reader.readexactly(length) if length else b""
-                code, headers, out = await self._process(
+                code, headers, out = await self._process_metered(
                     method, path, body, query, headers_in)
         except (asyncio.TimeoutError, asyncio.IncompleteReadError,
                 OSError):
@@ -129,6 +160,32 @@ class RGWGateway:
             pass
         finally:
             writer.close()
+
+    async def _process_metered(self, method: str, path: str, body: bytes,
+                               query: dict | None = None,
+                               headers_in: dict | None = None
+                               ) -> tuple[int, dict, bytes]:
+        """_process with per-request perf accounting (request/op/byte
+        counters + latency), so the gateway shows up in the aggregated
+        cluster metrics like every other daemon."""
+        t0 = time.monotonic()
+        self.perf.inc("req")
+        if body:
+            self.perf.inc("bytes_received", len(body))
+        try:
+            code, headers, out = await self._process(
+                method, path, body, query, headers_in)
+        finally:
+            self.perf.avg_add("req_latency", time.monotonic() - t0)
+        if method in ("GET", "HEAD"):
+            self.perf.inc("op_get")
+        elif method == "PUT":
+            self.perf.inc("op_put")
+        elif method == "DELETE":
+            self.perf.inc("op_del")
+        if out:
+            self.perf.inc("bytes_sent", len(out))
+        return code, headers, out
 
     # -- S3 semantics --------------------------------------------------------
 

@@ -199,7 +199,65 @@ def stage_cluster() -> dict:
             results[f"{key}_read_p99_ms"] = out["read"]["lat_p99_ms"]
             log(f"{key}: write {out['write']['mb_per_s']} MB/s "
                 f"read {out['read']['mb_per_s']} MB/s")
+
+    async def probe_health():
+        """One observability pass: boot a full cluster (mgr + mds +
+        rgw), let the report fan-in converge, then record the mon
+        health and the exporter's per-daemon labels so BENCH_r*.json
+        shows degradation alongside throughput."""
+        import re
+        import tempfile
+
+        from ceph_tpu.tools.vstart import VCluster
+        with tempfile.TemporaryDirectory(prefix="bench-health-") as base:
+            c = VCluster(base, n_mons=1, n_osds=3, with_mgr=True,
+                         with_mds=True, with_rgw=True)
+            try:
+                await c.start()
+                loop = asyncio.get_running_loop()
+                deadline = loop.time() + 30
+                want = {"osd", "mon", "mds", "rgw"}
+                while want - {st.service for st in
+                              c.mgr.daemon_index.daemons.values()}:
+                    if loop.time() > deadline:
+                        break
+                    await asyncio.sleep(0.25)
+                health = await c.mgr.mon_command({"prefix": "health"})
+                reader, writer = await asyncio.open_connection(
+                    *c.mgr.exporter.addr)
+                writer.write(b"GET /metrics HTTP/1.0\r\n\r\n")
+                await writer.drain()
+                blob = await reader.read()
+                writer.close()
+                text = blob.split(b"\r\n\r\n", 1)[1].decode()
+                results["health"] = {
+                    # the probe boots its own full cluster (rados_bench
+                    # tears its benchmark cluster down internally): this
+                    # records the observability plane converging, not
+                    # the bench cluster's load response
+                    "scope": "post-bench observability probe "
+                             "(fresh 3-osd + mgr/mds/rgw cluster)",
+                    "status": health.get("status"),
+                    "checks": sorted(health.get("checks", {})),
+                    "daemon_report_ages":
+                        c.mgr.daemon_index.report_ages(),
+                    "metric_daemons": sorted(
+                        set(re.findall(r'ceph_daemon="([^"]+)"', text))),
+                    "metric_lines": sum(
+                        1 for ln in text.splitlines()
+                        if ln.startswith("ceph_")),
+                }
+                log(f"health: {results['health']['status']} "
+                    f"checks={results['health']['checks']} "
+                    f"daemons={results['health']['metric_daemons']}")
+            finally:
+                await c.stop()
     asyncio.run(body())
+    try:
+        asyncio.run(asyncio.wait_for(probe_health(), 120))
+    except Exception as e:
+        results["health"] = {"status": f"probe failed: "
+                                       f"{type(e).__name__}: {e}"}
     return results
 
 

@@ -141,7 +141,8 @@ class CephadmCluster:
         for i in range(count):
             if i in self.mgrs:
                 continue
-            mgr = MgrDaemon(self.mon_addrs, auth_key=self.auth_key)
+            mgr = MgrDaemon(self.mon_addrs, auth_key=self.auth_key,
+                            name=str(i))
             await mgr.start()
             self.mgrs[i] = mgr
             actions.append(f"mgr.{i} deployed")
@@ -162,7 +163,8 @@ class CephadmCluster:
         for i in range(count):
             if i in self.mdss:
                 continue
-            mds = MDSDaemon(self.mon_addrs, auth_key=self.auth_key)
+            mds = MDSDaemon(self.mon_addrs, auth_key=self.auth_key,
+                            name=f"mds.{i}")
             await mds.start()
             self.mdss[i] = mds
             actions.append(f"mds.{i} deployed")
@@ -205,12 +207,14 @@ class CephadmCluster:
             await self.daemon_start("osd", did)
         elif kind == "mgr":
             from ceph_tpu.mgr import MgrDaemon
-            mgr = MgrDaemon(self.mon_addrs, auth_key=self.auth_key)
+            mgr = MgrDaemon(self.mon_addrs, auth_key=self.auth_key,
+                            name=str(did))
             await mgr.start()
             self.mgrs[did] = mgr
         elif kind == "mds":
             from ceph_tpu.mds import MDSDaemon
-            mds = MDSDaemon(self.mon_addrs, auth_key=self.auth_key)
+            mds = MDSDaemon(self.mon_addrs, auth_key=self.auth_key,
+                            name=f"mds.{did}")
             await mds.start()
             self.mdss[did] = mds
 

@@ -23,6 +23,9 @@ from ceph_tpu.mon.monitor import MonMap, Monitor
 from ceph_tpu.osd.daemon import OSD
 from ceph_tpu.rados.client import RadosClient
 
+MDS_POOLS = ("cephfs_metadata", "cephfs_data")
+RGW_POOL = "rgw_index"
+
 
 def free_ports(n: int) -> list[int]:
     socks = []
@@ -40,14 +43,22 @@ def free_ports(n: int) -> list[int]:
 class VCluster:
     """A running dev cluster: n mons + m osds, all in-process."""
 
-    def __init__(self, base_dir: str, n_mons: int = 1, n_osds: int = 3):
+    def __init__(self, base_dir: str, n_mons: int = 1, n_osds: int = 3,
+                 with_mgr: bool = False, with_mds: bool = False,
+                 with_rgw: bool = False):
         ports = free_ports(n_mons)
         self.monmap = MonMap({f"m{i}": ("127.0.0.1", ports[i])
                               for i in range(n_mons)})
         self.base_dir = base_dir
         self.n_osds = n_osds
+        self.with_mgr = with_mgr
+        self.with_mds = with_mds
+        self.with_rgw = with_rgw
         self.mons: dict[str, Monitor] = {}
         self.osds: dict[int, OSD] = {}
+        self.mgr = None
+        self.mds = None
+        self.rgw = None
         self.clients: list[RadosClient] = []
 
     @property
@@ -68,6 +79,27 @@ class VCluster:
             await asyncio.sleep(0.05)
         for i in range(self.n_osds):
             await self.start_osd(i)
+        if self.with_mgr:
+            from ceph_tpu.mgr import MgrDaemon
+            self.mgr = MgrDaemon(self.mon_addrs)
+            await self.mgr.start()
+        if self.with_mds:
+            from ceph_tpu.mds.daemon import MDSDaemon
+            cl = await self.client()
+            for pool in MDS_POOLS:
+                await cl.pool_create(pool, pg_num=8,
+                                     size=min(3, self.n_osds))
+            self.mds = MDSDaemon(self.mon_addrs,
+                                 metadata_pool=MDS_POOLS[0],
+                                 data_pool=MDS_POOLS[1])
+            await self.mds.start()
+        if self.with_rgw:
+            from ceph_tpu.rgw.gateway import RGWGateway
+            cl = await self.client()
+            await cl.pool_create(RGW_POOL, pg_num=8,
+                                 size=min(3, self.n_osds))
+            self.rgw = RGWGateway(cl.ioctx(RGW_POOL))
+            await self.rgw.start()
 
     async def start_osd(self, i: int, store=None) -> OSD:
         osd = OSD(i, self.mon_addrs, store=store)
@@ -85,6 +117,12 @@ class VCluster:
         return c
 
     async def stop(self) -> None:
+        for daemon in (self.rgw, self.mds, self.mgr):
+            if daemon is not None:
+                try:
+                    await asyncio.wait_for(daemon.stop(), 20)
+                except Exception:
+                    pass
         for c in self.clients:
             try:
                 await asyncio.wait_for(c.shutdown(), 20)

@@ -54,6 +54,9 @@ class AdminSocket:
                               "dump perf counter values")
         self.register_command("perf schema", lambda req: pc.schema(),
                               "dump perf counter schema")
+        self.register_command("perf reset",
+                              lambda req: pc.reset(req.get("logger")),
+                              "zero all perf counters (or one logger's)")
         self.register_command("dump_recent",
                               lambda req: get_logger().ring.entries(),
                               "recent log events")

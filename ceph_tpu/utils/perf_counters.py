@@ -129,6 +129,16 @@ class PerfCounters:
             return {key: {"type": t, "description": self._desc[key]}
                     for key, t in self._types.items()}
 
+    def reset(self) -> None:
+        """Zero every counter (admin-socket `perf reset`): values, avg
+        counts, and histogram buckets — the schema survives."""
+        with self._lock:
+            for key in self._types:
+                self._values[key] = 0
+                self._counts[key] = 0
+                if key in self._buckets:
+                    self._buckets[key] = [0] * 64
+
 
 class PerfCountersCollection:
     """Process-wide registry (perf dump aggregates all components)."""
@@ -173,3 +183,12 @@ class PerfCountersCollection:
         with self._lock:
             items = list(self._loggers.items())
         return {name: pc.schema() for name, pc in items}
+
+    def reset(self, logger: str | None = None) -> dict:
+        """Zero all counters (or one logger's): `perf reset` analog."""
+        with self._lock:
+            items = (list(self._loggers.items()) if logger is None
+                     else [(logger, self._loggers[logger])])
+        for _, pc in items:
+            pc.reset()
+        return {"reset": [name for name, _ in items]}

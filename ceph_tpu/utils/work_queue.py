@@ -138,6 +138,16 @@ class OpTracker:
     def dump_historic_slow_ops(self) -> dict:
         return {"ops": [op.to_dict() for op in self.historic_slow]}
 
+    def get_health_metrics(self) -> dict:
+        """Daemon health metrics for the mgr report (the reference's
+        OSDService::get_health_metrics feeding MMgrReport): in-flight
+        ops older than the slow threshold + the oldest such age. These
+        drive the mon's SLOW_OPS check."""
+        now_slow = [op.duration for op in self.ops_in_flight.values()
+                    if op.duration >= self.slow_threshold]
+        return {"slow_ops": len(now_slow),
+                "oldest_age_s": round(max(now_slow, default=0.0), 3)}
+
 
 class Finisher:
     """Ordered async completion drain (Finisher.h). queue() preserves

@@ -35,19 +35,19 @@ def test_exporter_serves_daemon_metrics_and_health(tmp_path):
                     _fetch, addr, "/metrics")
                 assert status == 200
                 # per-daemon op counters with labels, non-zero
-                assert 'ceph_op{daemon="osd.' in text
+                assert 'ceph_op{ceph_daemon="osd.' in text
                 assert any(
                     line.split()[-1] not in ("0", "0.0")
                     for line in text.splitlines()
                     if line.startswith("ceph_op{"))
                 assert "ceph_op_latency_sum" in text
-                assert "ceph_health_status 0" in text
+                assert "ceph_health_status{} 0" in text
                 # degrade the cluster: health gauge moves, check appears
                 await c.kill_osd(2)
                 await c.wait_osd_down(2)
                 status, text = await asyncio.to_thread(
                     _fetch, addr, "/metrics")
-                assert "ceph_health_status 1" in text
+                assert "ceph_health_status{} 1" in text
                 assert 'check="OSD_DOWN"' in text
                 status, body_ = await asyncio.to_thread(
                     _fetch, addr, "/health")

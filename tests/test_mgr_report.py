@@ -1,0 +1,381 @@
+"""Cluster-wide metrics aggregation + health-check engine tests:
+MMgrReport fan-in over real sockets (osd/mon/mds/rgw -> mgr), labeled
+prometheus export with staleness eviction, the mon health engine
+(SLOW_OPS via injected slow ops, mute/unmute with TTL), recovery
+progress events, and the metrics-name lint.
+
+Reference surfaces: src/mgr/MgrClient.cc + DaemonServer.cc (report
+fan-in), src/mon/MgrMonitor.cc (mgrmap + beacons), src/mon/
+health_check.h (check map + mutes), src/pybind/mgr/prometheus.
+"""
+from __future__ import annotations
+
+import asyncio
+import re
+
+import pytest
+
+from ceph_tpu.mgr import DaemonStateIndex, MgrClient, MgrDaemon
+from ceph_tpu.mgr.exporter import render_metrics
+from ceph_tpu.mon.monitor import MgrMonitor
+from ceph_tpu.utils.admin_socket import AdminSocket
+from ceph_tpu.utils.perf_counters import (TYPE_AVG, TYPE_HISTOGRAM,
+                                          PerfCountersCollection)
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+
+
+@pytest.fixture(autouse=True)
+def fast_reporting(monkeypatch):
+    """Tight report/beacon periods so fan-in converges in test time."""
+    monkeypatch.setattr(MgrClient, "REPORT_PERIOD", 0.2)
+    monkeypatch.setattr(MgrDaemon, "TICK_INTERVAL", 0.2)
+    monkeypatch.setattr(MgrDaemon, "REPORT_PERIOD", 0.2)
+    monkeypatch.setattr(DaemonStateIndex, "STALE_AFTER", 2.0)
+    monkeypatch.setattr(MgrMonitor, "BEACON_GRACE", 2.0)
+
+
+async def _http_get(addr, path: str) -> str:
+    reader, writer = await asyncio.open_connection(*addr)
+    writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+    await writer.drain()
+    blob = await reader.read()
+    writer.close()
+    return blob.split(b"\r\n\r\n", 1)[1].decode()
+
+
+async def _wait(cond, timeout=25.0, what="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            raise TimeoutError(f"never satisfied: {what}")
+        await asyncio.sleep(0.1)
+
+
+def test_report_fanin_all_services(tmp_path):
+    """A vstart cluster (3 osds + mon + mds + rgw + mgr) serves /metrics
+    where every daemon's counters appear with ceph_daemon labels,
+    delivered via MMgrReport over real sockets — with tracing off."""
+    from ceph_tpu.tools.vstart import VCluster
+    from ceph_tpu.utils import tracer
+    assert not tracer.enabled()
+
+    async def body():
+        c = VCluster(str(tmp_path), n_mons=1, n_osds=3,
+                     with_mgr=True, with_mds=True, with_rgw=True)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("fan", pg_num=4, size=3)
+            io = cl.ioctx("fan")
+            for i in range(8):
+                await io.write_full(f"o{i}", b"x" * 512)
+            # one rgw request so its op counters move
+            reader, writer = await asyncio.open_connection(*c.rgw.addr)
+            writer.write(b"PUT /b1 HTTP/1.0\r\nContent-Length: 0"
+                         b"\r\n\r\n")
+            await writer.drain()
+            await reader.read()
+            writer.close()
+
+            want = {"osd.0", "osd.1", "osd.2", "mon.m0", "mds.a",
+                    "rgw.0"}
+            await _wait(lambda: want <= set(c.mgr.daemon_index.daemons),
+                        what=f"reports from {want}")
+            # ...and the delta report carrying the rgw PUT
+            await _wait(
+                lambda: c.mgr.daemon_index.daemons["rgw.0"]
+                .counters.get("req"),
+                what="rgw req counter delta")
+            # delivered via report messages, not the shared registry
+            assert all(st.reports > 0 and st.counters
+                       for st in c.mgr.daemon_index.daemons.values())
+
+            text = await _http_get(c.mgr.exporter.addr, "/metrics")
+            for daemon in want:
+                assert f'ceph_daemon="{daemon}"' in text, daemon
+            # per-service counters with correct labels
+            assert re.search(r'ceph_op\{ceph_daemon="osd\.\d"\} \d', text)
+            assert 'ceph_paxos_commit{ceph_daemon="mon.m0"}' in text
+            assert 'ceph_request{ceph_daemon="mds.a"}' in text
+            assert 'ceph_req{ceph_daemon="rgw.0"}' in text
+            assert re.search(
+                r'ceph_daemon_report_age_seconds\{ceph_daemon="osd\.0"\}',
+                text)
+            # rgw actually counted its request
+            rgw_req = [ln for ln in text.splitlines()
+                       if ln.startswith('ceph_req{ceph_daemon="rgw.0"')]
+            assert rgw_req and int(rgw_req[0].split()[-1]) >= 1
+
+            # dashboard shows the per-daemon report table
+            page = await _http_get(c.mgr.exporter.addr, "/")
+            assert "report age" in page and "mds.a" in page
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_slow_ops_health_mute_ttl(tmp_path):
+    """An injected slow op raises SLOW_OPS through report -> digest ->
+    mon health; `health mute SLOW_OPS` suppresses it from the summary
+    status; the mute expires by TTL; finishing the op clears it."""
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=3)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("sp", pg_num=4, size=3)
+            mgr = MgrDaemon(c.mon_addrs, exporter_port=None)
+            await mgr.start()
+            try:
+                osd = c.osds[0]
+                osd.optracker.slow_threshold = 0.2
+                trk = osd.optracker.create("injected slow op")
+
+                async def has_slow_ops():
+                    h = await cl.command({"prefix": "health detail"})
+                    return "SLOW_OPS" in h["checks"]
+
+                deadline = asyncio.get_running_loop().time() + 25
+                while not await has_slow_ops():
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.2)
+                h = await cl.command({"prefix": "health detail"})
+                assert h["status"] == "HEALTH_WARN"
+                assert "slow ops" in h["checks"]["SLOW_OPS"]["summary"]
+                # the WARN transition lands in the cluster log on the
+                # next leader tick
+
+                async def in_clog():
+                    log = await cl.command({"prefix": "log last",
+                                            "num": 100})
+                    return any("SLOW_OPS" in e["message"]
+                               for e in log["lines"])
+                deadline = asyncio.get_running_loop().time() + 15
+                while not await in_clog():
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.2)
+
+                # mute with a TTL: gone from summary status, visible in
+                # detail as muted
+                await cl.command({"prefix": "health mute",
+                                  "code": "SLOW_OPS", "ttl": 2.0})
+                h = await cl.command({"prefix": "health"})
+                assert h["status"] == "HEALTH_OK", h
+                assert "SLOW_OPS" not in h["checks"]
+                assert "SLOW_OPS" in h["muted"]
+                hd = await cl.command({"prefix": "health detail"})
+                assert hd["muted"]["SLOW_OPS"].get("summary")
+
+                # the mute expires by TTL -> WARN again
+                deadline = asyncio.get_running_loop().time() + 20
+                while True:
+                    h = await cl.command({"prefix": "health"})
+                    if h["status"] == "HEALTH_WARN" \
+                            and "SLOW_OPS" in h["checks"]:
+                        break
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.2)
+
+                # finishing the op clears the check end to end
+                trk.finish()
+                deadline = asyncio.get_running_loop().time() + 20
+                while await has_slow_ops():
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.2)
+            finally:
+                await mgr.stop()
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_daemon_churn_eviction_and_rejoin(tmp_path):
+    """Kill an OSD mid-reporting: its metrics go stale and are evicted
+    from the index (and /metrics), health flips to OSD_DOWN; rejoin
+    clears the check and re-registers its counters (guards the
+    coll.remove re-register path in osd/daemon.py)."""
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=3)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("cp", pg_num=4, size=2)
+            io = cl.ioctx("cp")
+            for i in range(6):
+                await io.write_full(f"o{i}", b"y" * 256)
+            mgr = MgrDaemon(c.mon_addrs)
+            await mgr.start()
+            try:
+                await _wait(
+                    lambda: {"osd.0", "osd.1", "osd.2"}
+                    <= set(mgr.daemon_index.daemons),
+                    what="all osd reports")
+
+                await c.kill_osd(2)
+                # stale -> evicted from the index and the export
+                await _wait(
+                    lambda: "osd.2" not in mgr.daemon_index.daemons,
+                    what="osd.2 eviction")
+                text = await _http_get(mgr.exporter.addr, "/metrics")
+                assert 'ceph_daemon="osd.2"' not in text
+                assert 'ceph_daemon="osd.0"' in text
+                # health sees the dead osd (mon-side heartbeat path)
+                await c.wait_osd_down(2)
+                h = await cl.command({"prefix": "health"})
+                assert "OSD_DOWN" in h["checks"]
+
+                # rejoin: counters re-register, reports resume, check
+                # clears
+                await c.start_osd(2)
+                await _wait(
+                    lambda: "osd.2" in mgr.daemon_index.daemons,
+                    what="osd.2 re-report")
+                assert PerfCountersCollection.instance().get("osd.2") \
+                    is c.osds[2].perf
+                deadline = asyncio.get_running_loop().time() + 25
+                while True:
+                    h = await cl.command({"prefix": "health"})
+                    if "OSD_DOWN" not in h["checks"]:
+                        break
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.2)
+                text = await _http_get(mgr.exporter.addr, "/metrics")
+                assert 'ceph_daemon="osd.2"' in text
+            finally:
+                await mgr.stop()
+        finally:
+            await c.stop()
+    run(body())
+
+
+def _fake_report(name, service, counters, schema, **extra):
+    return dict({"daemon_name": name, "service": service,
+                 "schema": schema, "counters": counters,
+                 "daemon_status": {}, "health_metrics": {},
+                 "progress": []}, **extra)
+
+
+def test_metrics_name_lint():
+    """Every rendered sample line matches
+    ^ceph_[a-z0-9_]+(_bucket|_sum|_count)?{ and each metric family has
+    exactly one # TYPE line — catches _sanitize collisions and
+    duplicate-TYPE regressions for all current and future counters."""
+    index = DaemonStateIndex()
+    schema = {"op": {"type": "u64"}, "Weird-Name.x": {"type": "u64"},
+              "lat": {"type": "avg"}, "hist_us": {"type": "histogram"},
+              "load": {"type": "gauge"}}
+    for daemon in ("osd.0", "osd.1", "mds.a"):
+        index.report(_fake_report(
+            daemon, daemon.split(".")[0], schema=schema,
+            counters={"op": 7, "Weird-Name.x": 1,
+                      "lat": {"avgcount": 2, "sum": 0.5},
+                      "hist_us": {"count": 3, "sum": 99.0,
+                                  "buckets": {"2^3": 2, "2^5": 1}},
+                      "load": 4},
+            progress=[{"id": "recovery-1.2", "message": "recovery",
+                       "progress": 0.5}]))
+    health = {"status": "HEALTH_WARN",
+              "checks": {"OSD_DOWN": {"severity": "HEALTH_WARN",
+                                      "summary": "1 osds down"}},
+              "muted": {"SLOW_OPS": {"expires_in_s": 5}}}
+    text = render_metrics(health, index=index)
+    sample_re = re.compile(r"^ceph_[a-z0-9_]+(_bucket|_sum|_count)?\{")
+    families_seen: set[str] = set()
+    type_lines: list[str] = []
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            type_lines.append(line.split()[2])
+            continue
+        assert sample_re.match(line), f"lint fail: {line!r}"
+        base = line.split("{", 1)[0]
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) \
+                    and base.removesuffix(suffix) in type_lines:
+                base = base.removesuffix(suffix)
+                break
+        families_seen.add(base)
+    # exactly one TYPE line per family, and every family has one
+    assert len(type_lines) == len(set(type_lines)), type_lines
+    assert families_seen <= set(type_lines), \
+        families_seen - set(type_lines)
+    # the local-registry fallback path obeys the same lint
+    coll = PerfCountersCollection.instance()
+    coll.remove("lint.test")
+    pc = coll.create("lint.test")
+    pc.add("plain")
+    pc.add("an_avg", type=TYPE_AVG)
+    pc.add("a_hist", type=TYPE_HISTOGRAM)
+    pc.avg_add("an_avg", 1.0)
+    pc.hist_add("a_hist", 100)
+    try:
+        text = render_metrics()
+        for line in text.strip().splitlines():
+            if not line.startswith("# "):
+                assert sample_re.match(line), f"lint fail: {line!r}"
+    finally:
+        coll.remove("lint.test")
+
+
+def test_digest_checks_and_progress():
+    """The mgr's digest turns daemon health metrics into SLOW_OPS /
+    PG_DEGRADED / OSD_NEARFULL / OSD_FULL checks and merges progress
+    events; the exporter renders ceph_progress_* gauges."""
+    mgr = MgrDaemon.__new__(MgrDaemon)     # digest logic only, no I/O
+    mgr.name = "x"
+    mgr.daemon_index = DaemonStateIndex()
+    mgr.daemon_index.report(_fake_report(
+        "osd.0", "osd", schema={}, counters={},
+        health_metrics={"slow_ops": 2, "slow_ops_oldest_age_s": 7.5,
+                        "degraded_pgs": 3, "undersized_pgs": 1,
+                        "store": {"utilization": 0.90}},
+        progress=[{"id": "recovery-1.0", "message": "recovery pg 1.0",
+                   "progress": 0.25}]))
+    mgr.daemon_index.report(_fake_report(
+        "osd.1", "osd", schema={}, counters={},
+        health_metrics={"store": {"utilization": 0.96}}))
+    digest = mgr._build_digest()
+    checks = digest["checks"]
+    assert checks["SLOW_OPS"]["severity"] == "HEALTH_WARN"
+    assert "2 slow ops" in checks["SLOW_OPS"]["summary"]
+    assert "7.5" in checks["SLOW_OPS"]["summary"]
+    assert checks["PG_DEGRADED"]["summary"].startswith("3 pgs")
+    assert checks["PG_UNDERSIZED"]["summary"].startswith("1 pgs")
+    assert checks["OSD_NEARFULL"]["detail"] == ["osd.0 is 90% full"]
+    assert checks["OSD_FULL"]["severity"] == "HEALTH_ERR"
+    assert digest["progress"][0]["daemon"] == "osd.0"
+    assert set(digest["daemons"]) == {"osd.0", "osd.1"}
+    assert digest["from"] == "x"   # the mon drops non-active senders
+    text = render_metrics(index=mgr.daemon_index)
+    assert "# TYPE ceph_progress_fraction gauge" in text
+    assert 'ceph_progress_fraction{id="recovery-1.0",' \
+           'ceph_daemon="osd.0"} 0.25' in text
+
+
+def test_perf_reset(tmp_path):
+    """Admin-socket `perf reset` zeros every counter in the process
+    registry (values, avg counts, histogram buckets) in place."""
+    coll = PerfCountersCollection.instance()
+    coll.remove("reset.test")
+    pc = coll.create("reset.test")
+    pc.add("n")
+    pc.add("lat", type=TYPE_AVG)
+    pc.add("h_us", type=TYPE_HISTOGRAM)
+    pc.inc("n", 5)
+    pc.avg_add("lat", 1.5)
+    pc.hist_add("h_us", 300)
+    asok = AdminSocket(str(tmp_path / "asok"))
+    try:
+        out = asok.execute({"prefix": "perf reset",
+                            "logger": "reset.test"})
+        assert "reset.test" in out["result"]["reset"]
+        dump = pc.dump()
+        assert dump["n"] == 0
+        assert dump["lat"] == {"avgcount": 0, "sum": 0}
+        assert dump["h_us"]["count"] == 0 and \
+            dump["h_us"]["buckets"] == {}
+        # schema survives a reset and counters keep working
+        pc.inc("n")
+        assert pc.dump()["n"] == 1
+    finally:
+        coll.remove("reset.test")

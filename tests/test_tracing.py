@@ -125,7 +125,7 @@ def test_ec_write_produces_one_connected_trace(tmp_path):
             # cumulative: +Inf count equals _count for one daemon line
             lines = [ln for ln in text.splitlines()
                      if ln.startswith('ceph_ec_encode_us_bucket'
-                                      '{daemon="osd.0"')]
+                                      '{ceph_daemon="osd.0"')]
             if lines:
                 vals = [int(ln.rsplit(" ", 1)[1]) for ln in lines]
                 assert vals == sorted(vals)
