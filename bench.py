@@ -38,6 +38,7 @@ from ceph_tpu.utils import tracer  # noqa: E402
 TOTAL_BUDGET = int(os.environ.get("CEPH_TPU_BENCH_TIMEOUT", "2400"))
 CPU_TIMEOUT = 420
 DEVICE_TIMEOUT = 900  # single long warm: backend init + benches, one child
+CLUSTER_TPU_TIMEOUT = 420  # in-situ EC-over-tpu cluster stage
 METRIC = "ec_encode_k8m3_1MiB_chunk"
 
 _deadline = time.monotonic() + TOTAL_BUDGET
@@ -134,10 +135,28 @@ def main() -> int:
         if fallback.get("status") == "ok":
             device = fallback
 
+    # Stage 3: cluster-EC-over-tpu — the in-situ data path on the device
+    # plugin, offload-batched vs per-op inline dispatch (k=8,m=3). Tries
+    # the real device first; falls back hermetic so the batching numbers
+    # exist either way (platform is recorded inside the stage output).
+    cluster_tpu = run_stage("cluster_tpu", _tpu_env(),
+                            _budget(CLUSTER_TPU_TIMEOUT))
+    stages["cluster_tpu"] = cluster_tpu
+    if cluster_tpu.get("status") != "ok":
+        fallback = run_stage("cluster_tpu", _hermetic_env(),
+                             _budget(min(CLUSTER_TPU_TIMEOUT,
+                                         _deadline - time.monotonic())))
+        stages["cluster_tpu_fallback"] = fallback
+        if fallback.get("status") == "ok":
+            cluster_tpu = fallback
+
     detail = {k: v for k, v in cpu.items()
               if k not in ("status", "elapsed_s", "stderr_tail")}
     detail.update({k: v for k, v in cluster.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})
+    detail.update({k: v for k, v in cluster_tpu.items()
+                   if k not in ("status", "elapsed_s", "stderr_tail",
+                                "offload_status")})
     detail.update({k: v for k, v in device.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})
 

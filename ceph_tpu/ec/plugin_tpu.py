@@ -50,6 +50,11 @@ DEFAULT_M = 3
 
 class ErasureCodeTpu(ErasureCodeJerasure):
     technique = "reed_sol_van"
+    #: batched APIs dispatch to the accelerator: the offload service
+    #: routes/queues only plugins that set this — the jerasure family
+    #: has the same encode_stripes signature but runs on host, where
+    #: the admission queue's linger buys nothing
+    device_batched = True
 
     def init(self, profile: Mapping[str, str]) -> None:
         profile = dict(profile)

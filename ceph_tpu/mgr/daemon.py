@@ -313,6 +313,7 @@ class MgrDaemon(Dispatcher):
         slow_total, slow_oldest, slow_detail = 0, 0.0, []
         degraded, undersized = [], []
         nearfull, full = [], []
+        offload_degraded = []
         for name, st in sorted(self.daemon_index.daemons.items()):
             hm = st.health_metrics or {}
             n = int(hm.get("slow_ops") or 0)
@@ -326,6 +327,10 @@ class MgrDaemon(Dispatcher):
                 degraded.append((name, int(hm["degraded_pgs"])))
             if hm.get("undersized_pgs"):
                 undersized.append((name, int(hm["undersized_pgs"])))
+            off = hm.get("offload") or {}
+            if off.get("degraded"):
+                offload_degraded.append(
+                    (name, off.get("last_error") or "device error"))
             store = hm.get("store") or {}
             util = float(store.get("utilization") or 0.0)
             if util >= self.FULL_RATIO:
@@ -362,6 +367,15 @@ class MgrDaemon(Dispatcher):
                 "severity": "HEALTH_ERR",
                 "summary": f"{len(full)} osds full",
                 "detail": [f"{d} is {u:.0%} full" for d, u in full]}
+        if offload_degraded:
+            # the EC data path still serves (host-codec fallback is
+            # bit-identical) but at host speed: warn, don't err
+            checks["TPU_OFFLOAD_DEGRADED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(offload_degraded)} daemons running EC "
+                           f"on the host-codec fallback (device offload "
+                           f"degraded)",
+                "detail": [f"{d}: {err}" for d, err in offload_degraded]}
         return {"from": self.name,
                 "checks": checks,
                 "progress": self.daemon_index.progress_events(),

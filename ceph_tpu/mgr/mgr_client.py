@@ -44,7 +44,8 @@ class MgrClient(Dispatcher):
                  status_cb: Callable[[], dict] | None = None,
                  health_cb: Callable[[], dict] | None = None,
                  progress_cb: Callable[[], list] | None = None,
-                 perf_name: str | None = None):
+                 perf_name: str | None = None,
+                 extra_loggers: tuple[str, ...] = ()):
         self.messenger = messenger
         self.messenger.add_dispatcher(self)
         self.daemon_name = daemon_name
@@ -54,6 +55,11 @@ class MgrClient(Dispatcher):
         self.health_cb = health_cb
         self.progress_cb = progress_cb
         self.perf_name = perf_name or daemon_name
+        # process-shared perf loggers this daemon also reports (e.g. the
+        # EC offload service's "offload" counters), merged into the
+        # report with a "<logger>_" key prefix so the mgr/exporter sees
+        # them per reporting daemon
+        self.extra_loggers = tuple(extra_loggers)
         self.period = self.REPORT_PERIOD
         self.reports_sent = 0
         self._conn: Connection | None = None
@@ -135,9 +141,17 @@ class MgrClient(Dispatcher):
             return False
         payload: dict = {"daemon_name": self.daemon_name,
                          "service": self.service, "stamp": time.time()}
-        pc = PerfCountersCollection.instance().get(self.perf_name)
-        if pc is not None:
-            schema = pc.schema()
+        coll = PerfCountersCollection.instance()
+        schema: dict = {}
+        dump: dict = {}
+        for logger, prefix in [(self.perf_name, "")] + [
+                (ln, f"{ln}_") for ln in self.extra_loggers]:
+            pc = coll.get(logger)
+            if pc is None:
+                continue
+            schema.update({prefix + k: v for k, v in pc.schema().items()})
+            dump.update({prefix + k: v for k, v in pc.dump().items()})
+        if schema:
             keys = frozenset(schema)
             if keys != self._schema_keys_sent:
                 # once per session — and again if the key set changed
@@ -145,7 +159,6 @@ class MgrClient(Dispatcher):
                 payload["schema"] = schema
                 self._schema_keys_sent = keys
                 self._last_sent = {}
-            dump = pc.dump()
             # deltas: only counters whose value moved since the last
             # report travel; the mgr merges into its stored copy
             payload["counters"] = {k: v for k, v in dump.items()

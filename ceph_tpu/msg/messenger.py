@@ -572,7 +572,17 @@ class Messenger:
         # acceptor-side sessions by (entity, cookie) for reconnect matching
         self._sessions: dict[tuple[str, int], Connection] = {}
         self._connect_locks: dict[tuple[str, int], asyncio.Lock] = {}
+        # detached close() tasks (superseded-session GC): tracked so
+        # shutdown() can await them — an untracked close task spawned
+        # during teardown is destroyed while pending and leaks the
+        # connection's dispatch loop (the BENCH_r05 tail spam)
+        self._bg_tasks: set[asyncio.Task] = set()
         self._closed = False
+
+    def _spawn_bg(self, coro) -> None:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     def add_dispatcher(self, d: Dispatcher) -> None:
         self.dispatchers.append(d)
@@ -711,7 +721,7 @@ class Messenger:
             for old_key, old in list(self._sessions.items()):
                 if old_key[0] == key[0] and old_key != key:
                     del self._sessions[old_key]
-                    asyncio.get_running_loop().create_task(old.close())
+                    self._spawn_bg(old.close())
             self._sessions[key] = conn
         peer = writer.get_extra_info("peername")
         if peer:
@@ -778,5 +788,13 @@ class Messenger:
         self._conns.clear()
         self._accepted.clear()
         self._sessions.clear()
+        # reap detached close tasks: every connection task must be DONE
+        # when shutdown returns, or loop teardown destroys them pending
+        for task in list(self._bg_tasks):
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._bg_tasks.clear()
         if self._server is not None:
             await self._server.wait_closed()

@@ -146,6 +146,13 @@ class BlueStore(ObjectStore):
             os.path.join(path, "db"))
         self._block = None
         self.alloc = BitmapAllocator()
+        # per-AU block checksums through the shared Checksummer engine
+        # (bluestore_blob_t csum_data at csum_block_size granularity:
+        # a single corrupt AU pinpoints instead of failing the whole
+        # extent; the engine is the same one the offload service batches
+        # for the EC shard csums)
+        from ceph_tpu.utils.checksummer import Checksummer
+        self.csum = Checksummer("crc32c", AU)
         # test hook: crash after block-file data writes, before the KV
         # batch commit (the txc window the ordering protects)
         self.fail_before_kv = False
@@ -201,7 +208,24 @@ class BlueStore(ObjectStore):
         for unit, count, crc in on["extents"]:
             self._block.seek(unit * AU)
             chunk = self._block.read(count * AU)
-            if _crc32c(chunk) != crc:
+            if len(chunk) != count * AU:
+                # truncated block file (crash mid-write): same EIO
+                # contract as a csum mismatch, so read-repair callers
+                # catch it — Checksummer.verify would raise ValueError
+                # on the short buffer instead
+                raise StoreError(
+                    "EIO", f"short read at unit {unit}: "
+                           f"{len(chunk)} of {count * AU} bytes")
+            if isinstance(crc, list):
+                import numpy as np
+                bad = self.csum.verify(chunk,
+                                       np.asarray(crc, dtype=np.uint32))
+                if bad >= 0:
+                    raise StoreError(
+                        "EIO", f"csum mismatch at unit {unit} "
+                               f"(+{bad} bytes)")
+            elif _crc32c(chunk) != crc:
+                # whole-extent crc written before the per-AU format
                 raise StoreError("EIO",
                                  f"csum mismatch at unit {unit}")
             out.extend(chunk)
@@ -229,7 +253,8 @@ class BlueStore(ObjectStore):
             chunk = padded[off:off + count * AU]
             self._block.seek(unit * AU)
             self._block.write(chunk)
-            extents.append([unit, count, _crc32c(chunk)])
+            extents.append([unit, count,
+                            [int(x) for x in self.csum.calculate(chunk)]])
             off += count * AU
         on["extents"] = extents
         ctx.block_dirty = True

@@ -90,6 +90,12 @@ class OSD(Dispatcher):
         # op tracing rides the same config (hot-togglable: `config set
         # tracer_enabled true` over the admin socket starts collecting)
         tracer.register_config(self.config)
+        # the process-wide EC offload service's knobs (ec_offload_*)
+        # ride this daemon's config too: `config set
+        # ec_offload_linger_ms 5` over the admin socket retunes the
+        # batcher live via the config observer
+        from ceph_tpu import offload
+        offload.register_config(self.config)
         # per-daemon perf counters, served by `perf dump` (the admin
         # socket reads the process-wide collection)
         coll = PerfCountersCollection.instance()
@@ -154,6 +160,14 @@ class OSD(Dispatcher):
             self.asok.register_command(
                 "status", lambda req: self._daemon_status(),
                 "daemon status")
+            self.asok.register_command(
+                "ec offload status",
+                lambda req: self._offload_admin("status"),
+                "offload service: queue/batch/fallback stats + settings")
+            self.asok.register_command(
+                "ec offload flush",
+                lambda req: self._offload_admin("flush"),
+                "force-flush every pending offload batch bucket")
         self.messenger = Messenger(f"osd.{whoami}", auth_key=auth_key)
         self.messenger.add_dispatcher(self)
         self.monc = MonClient(self.messenger, mon_addrs)
@@ -166,7 +180,11 @@ class OSD(Dispatcher):
             resolve=lambda: (self.monc.mgrmap or {}).get("active_addr"),
             status_cb=self._daemon_status,
             health_cb=self._mgr_health_metrics,
-            progress_cb=self._mgr_progress)
+            progress_cb=self._mgr_progress,
+            extra_loggers=("offload",))
+        # the per-loop offload service handle (set at start(): the
+        # admin-socket thread cannot resolve the running loop itself)
+        self._offload_svc = None
         self.osdmap = OSDMap()
         self.pgs: dict[PG, PGInstance] = {}
         self.addr: tuple[str, int] | None = None
@@ -210,6 +228,8 @@ class OSD(Dispatcher):
                 raise
             self.store.mkfs()
             self.store.mount()
+        from ceph_tpu import offload
+        self._offload_svc = offload.get_service()
         self.op_queue.start()
         self.finisher.start()
         if self.asok is not None:
@@ -268,7 +288,18 @@ class OSD(Dispatcher):
                 "pg_states": states,
                 "degraded_pgs": degraded,
                 "undersized_pgs": undersized,
+                # device-offload circuit-breaker state: the mgr digests
+                # a degraded service into TPU_OFFLOAD_DEGRADED
+                "offload": (self._offload_svc.health_metrics()
+                            if self._offload_svc is not None else {}),
                 "store": self.store.statfs()}
+
+    def _offload_admin(self, cmd: str) -> dict:
+        if self._offload_svc is None:
+            return {"error": "offload service not started"}
+        if cmd == "flush":
+            return self._offload_svc.flush()
+        return self._offload_svc.status()
 
     def _mgr_progress(self) -> list:
         """Completion fractions for in-flight recovery/backfill (the
@@ -349,13 +380,19 @@ class OSD(Dispatcher):
 
     async def stop(self) -> None:
         self._stopping = True
-        for task in (self._hb_task, self._scrub_task, self._reboot_task):
-            if task is not None:
-                task.cancel()
-                try:
-                    await task
-                except (asyncio.CancelledError, Exception):
-                    pass
+        bg = [t for t in (self._hb_task, self._scrub_task,
+                          self._reboot_task) if t is not None]
+        # background + detached-notify tasks too: anything left pending
+        # when the loop closes is destroyed (messenger leak's sibling)
+        bg += list(self._bg_tasks) + list(self._notify_tasks)
+        for task in bg:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._bg_tasks.clear()
+        self._notify_tasks.clear()
         for pg in self.pgs.values():
             pg._cancel_peering()
             pg.backend.fail_inflight("osd stopping")
@@ -485,7 +522,11 @@ class OSD(Dispatcher):
     def _drop_conn(self, peer: int) -> None:
         conn = self._conns.pop(peer, None)
         if conn is not None:
-            asyncio.get_running_loop().create_task(conn.close())
+            # tracked: stop() reaps these, so a close racing daemon
+            # teardown can't be destroyed while pending
+            t = asyncio.get_running_loop().create_task(conn.close())
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_task_done)
 
     # -- heartbeats / failure reporting (OSD::heartbeat) ---------------------
 

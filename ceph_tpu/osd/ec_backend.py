@@ -39,6 +39,7 @@ import time
 
 from ceph_tpu.crush.crush import CRUSH_NONE
 from ceph_tpu.ec import registry
+from ceph_tpu.offload import get_service_or_none
 from ceph_tpu.msg.messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
                                    MOSDECSubOpWrite, MOSDECSubOpWriteReply)
 from ceph_tpu.objectstore.store import StoreError
@@ -75,6 +76,16 @@ class ECBackend(PGBackend):
         self.sinfo = ec_util.StripeInfo(self.k, width)
         from ceph_tpu.native import ec_native
         self._crc32c = ec_native.crc32c
+        # the per-chunk shard csum engine (BlueStore Checksummer analog);
+        # its async path submits through the offload service. None when
+        # the chunk size isn't a power of two (bitmatrix techniques pad
+        # to w*64, e.g. liberation's 4480): Checksummer enforces the
+        # reference's pow2 csum_block_size, and those pools take the
+        # native sync path anyway
+        from ceph_tpu.utils.checksummer import Checksummer
+        c = self.sinfo.chunk_size
+        self._checksummer = Checksummer("crc32c", c) \
+            if c & (c - 1) == 0 else None
         # crc of an all-zero chunk: hole stripes materialize as zeros
         self._zcrc = self._crc32c(b"\x00" * self.sinfo.chunk_size)
         # read gather plumbing: tid -> future resolving to (payload, data)
@@ -100,12 +111,23 @@ class ECBackend(PGBackend):
         w = self.sinfo.stripe_width
         return data + b"\x00" * ((-len(data)) % w)
 
-    def _encode(self, data: bytes) -> dict[int, bytes]:
-        """One batched encode dispatch, sampled into the daemon's
-        `ec_encode_us` histogram (ec_util opens the per-dispatch span
-        with bytes/k/m tags)."""
+    def _offload_svc(self):
+        """The offload service, for DEVICE-batched plugins only: the
+        jerasure family exposes the same batched API but computes on
+        host, where queueing per-op work behind a linger deadline only
+        adds latency (code-review finding)."""
+        if getattr(self.ec_impl, "device_batched", False):
+            return get_service_or_none()
+        return None
+
+    async def _encode(self, data: bytes) -> dict[int, bytes]:
+        """One batched encode dispatch through the process-wide offload
+        service — concurrent PGs' stripes coalesce into one device
+        batch — sampled into the daemon's `ec_encode_us` histogram
+        (ec_util opens the per-dispatch span with bytes/k/m tags)."""
         t0 = time.perf_counter()
-        shards = ec_util.encode(self.sinfo, self.ec_impl, data)
+        shards = await ec_util.encode_async(self.sinfo, self.ec_impl, data,
+                                            service=self._offload_svc())
         self.host.perf.hist_add("ec_encode_us",
                                 (time.perf_counter() - t0) * 1e6)
         return shards
@@ -122,6 +144,37 @@ class ECBackend(PGBackend):
                 np.frombuffer(shard_buf, dtype=np.uint8), c)]
         return [self._crc32c(shard_buf[i:i + c])
                 for i in range(0, len(shard_buf), c)]
+
+    async def _csums_shards(
+            self, shards: dict[int, bytes]) -> dict[int, list[int]]:
+        """Per-chunk crc32c lists for ALL shards of one write in a
+        single CrcJob through the offload service: the n per-shard
+        checksum calls become one batch that also coalesces with
+        concurrent writers and runs off the event loop (the BlueStore
+        Checksummer's batch shape, src/common/Checksummer.h:195-234)."""
+        c = self.sinfo.chunk_size
+        # only the device-plugin pools ride the queue (a jerasure pool
+        # gains nothing from the linger wait its writes would pay), and
+        # only when the crc work is big enough to beat the queue round
+        # trip — the native kernel does a tiny op's csums in ~30 µs,
+        # cheaper than any linger
+        svc = self._offload_svc()
+        lens = {len(b) for b in shards.values()}
+        total_blocks = sum(len(b) for b in shards.values()) // c
+        if (svc is None or self._checksummer is None or not shards
+                or lens == {0} or any(ln % c for ln in lens)
+                or (total_blocks < 256 and not svc.crc_device)):
+            return {i: self._csums(b) for i, b in shards.items()}
+        order = sorted(shards)
+        crcs = await self._checksummer.calculate_async(
+            b"".join(shards[i] for i in order), service=svc)
+        out: dict[int, list[int]] = {}
+        row = 0
+        for i in order:
+            n = len(shards[i]) // c
+            out[i] = [int(x) for x in crcs[row:row + n]]
+            row += n
+        return out
 
     def _chunk_attrs(self, shard: int, size: int, version,
                      csums: list[int]) -> dict:
@@ -211,8 +264,9 @@ class ECBackend(PGBackend):
 
         if op in ("write_full", "push"):
             padded = self._pad(data)
-            shards = self._encode(padded) \
+            shards = await self._encode(padded) \
                 if padded else {i: b"" for i in range(self.n)}
+            csums = await self._csums_shards(shards)
             # WRITEFULL replaces data, not xattrs: the full-state shard
             # rewrite must carry the user attrs forward (the primary's
             # copy is authoritative — xattrs replicate to every shard)
@@ -221,7 +275,7 @@ class ECBackend(PGBackend):
                 i: ({"op": "write_full",
                      "attrs": self._encode_attrs({**self._chunk_attrs(
                          i, len(data), entry.version,
-                         self._csums(shards[i])), **uattrs})},
+                         csums[i]), **uattrs})},
                     shards[i])
                 for i in live}
         elif op in ("delete", "remove"):
@@ -359,7 +413,9 @@ class ECBackend(PGBackend):
             got, _, _ = await self._gather_chunks(
                 oid, chunk_off=first * c,
                 chunk_len=(read_upto - first) * c)
-            existing = ec_util.decode_concat(self.sinfo, self.ec_impl, got)
+            existing = await ec_util.decode_concat_async(
+                self.sinfo, self.ec_impl, got,
+                service=self._offload_svc())
         region = bytearray((last - first) * w)
         region[:len(existing)] = existing
         if existing:
@@ -379,7 +435,8 @@ class ECBackend(PGBackend):
         if tail < len(region):
             region[tail:] = b"\x00" * (len(region) - tail)
 
-        shards = self._encode(bytes(region))
+        shards = await self._encode(bytes(region))
+        csums = await self._csums_shards(shards)
         new_n = -(-new_size // w)
         payloads = {}
         for i in live:
@@ -387,7 +444,7 @@ class ECBackend(PGBackend):
             # updates: _apply_extent fills missing csum slots with the
             # zero-chunk crc, matching the store's gap zero-fill
             updates = [[first + s_rel, crc]
-                       for s_rel, crc in enumerate(self._csums(shards[i]))]
+                       for s_rel, crc in enumerate(csums[i])]
             payloads[i] = ({"op": "extent_write",
                             "chunk_off": first * c,
                             "new_size": new_size,
@@ -818,7 +875,8 @@ class ECBackend(PGBackend):
             chunk_off, chunk_len = first * c, (last - first) * c
         got, ec_size, _ = await self._gather_chunks(
             oid, chunk_off=chunk_off, chunk_len=chunk_len, snap=snap)
-        data = ec_util.decode_concat(self.sinfo, self.ec_impl, got)
+        data = await ec_util.decode_concat_async(
+            self.sinfo, self.ec_impl, got, service=self._offload_svc())
         start = offset - first * w
         end = (ec_size if length <= 0 else min(offset + length, ec_size)) \
             - first * w
@@ -960,8 +1018,9 @@ class ECBackend(PGBackend):
         # of that very write would be answered "already done" while its
         # data is gone (found by the thrashing model checker)
         self.pg.log.invalidate_reqids_for(oid, newer_than=rolled_to)
-        data = ec_util.decode_concat(self.sinfo, self.ec_impl,
-                                     got)[:ec_size]
+        data = (await ec_util.decode_concat_async(
+            self.sinfo, self.ec_impl, got,
+            service=self._offload_svc()))[:ec_size]
         version = self.pg.next_version()
         entry = LogEntry(version=version, op="modify", oid=oid,
                          prior_version=self.pg._prior(oid))
@@ -992,8 +1051,9 @@ class ECBackend(PGBackend):
         if idx in got:
             chunk = got[idx]
         else:
-            chunk = ec_util.decode_shards(self.sinfo, self.ec_impl,
-                                          got, [idx])[idx]
+            chunk = (await ec_util.decode_shards_async(
+                self.sinfo, self.ec_impl, got, [idx],
+                service=self._offload_svc()))[idx]
         attrs = self._chunk_attrs(idx, ec_size, meta["version"],
                                   self._csums(chunk))
         for name, val in meta.get("uattrs", {}).items():
@@ -1032,8 +1092,9 @@ class ECBackend(PGBackend):
         if idx in got:
             chunk = got[idx]
         else:
-            chunk = ec_util.decode_shards(self.sinfo, self.ec_impl,
-                                          got, [idx])[idx]
+            chunk = (await ec_util.decode_shards_async(
+                self.sinfo, self.ec_impl, got, [idx],
+                service=self._offload_svc()))[idx]
         return chunk, self._chunk_attrs(idx, ec_size, meta["version"],
                                         self._csums(chunk))
 

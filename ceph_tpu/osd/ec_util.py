@@ -105,15 +105,9 @@ class StripeInfo:
 # Stripe codec driver
 # ---------------------------------------------------------------------------
 
-def encode(sinfo: StripeInfo, ec_impl, data: bytes | np.ndarray,
-           want: Iterable[int] | None = None) -> dict[int, bytes]:
-    """Encode a stripe-aligned logical buffer into per-shard buffers.
-
-    Equivalent of ECUtil::encode (ECUtil.cc:134): input length must be a
-    multiple of stripe_width; output maps shard id -> contiguous buffer of
-    one chunk per stripe. One batched device dispatch when the plugin
-    supports it, else the reference's per-stripe loop.
-    """
+def _encode_frame(sinfo: StripeInfo, ec_impl, data, want):
+    """Shared validation/framing for encode(): returns
+    (stripes (S,k,C) | None, want set, k, n_chunks, mapping, batched)."""
     buf = np.frombuffer(data, dtype=np.uint8) if isinstance(
         data, (bytes, bytearray, memoryview)) else np.ascontiguousarray(
         data, dtype=np.uint8).reshape(-1)
@@ -130,71 +124,140 @@ def encode(sinfo: StripeInfo, ec_impl, data: bytes | np.ndarray,
         raise ErasureCodeError(f"want ids {sorted(want)} out of range "
                                f"0..{n_chunks - 1}")
     n_stripes = buf.size // sinfo.stripe_width
-    if n_stripes == 0:
-        return {i: b"" for i in sorted(want)}
-
     mapping = ec_impl.get_chunk_mapping()
     batched = callable(getattr(ec_impl, "encode_stripes", None)) \
         and not mapping
+    stripes = None if n_stripes == 0 else \
+        buf.reshape(n_stripes, k, sinfo.chunk_size)
+    return stripes, want, k, n_chunks, mapping, batched
+
+
+def _encode_assemble(stripes: np.ndarray, parity: np.ndarray, k: int,
+                     want) -> dict[int, bytes]:
+    # shard-major contiguous copies first: .tobytes() on a strided
+    # view falls off numpy's memcpy path (~30x slower — profiled on
+    # the OSD write path)
+    dm = np.ascontiguousarray(stripes.transpose(1, 0, 2))      # (k,S,C)
+    pm = np.ascontiguousarray(parity.transpose(1, 0, 2))       # (m,S,C)
+    return {i: (dm[i] if i < k else pm[i - k]).tobytes()
+            for i in sorted(want)}
+
+
+def _encode_scalar(sinfo: StripeInfo, ec_impl, stripes, want, k, n_chunks,
+                   mapping) -> dict[int, bytes]:
+    """The reference's per-stripe loop through the scalar contract."""
+    data_pos = mapping if mapping else list(range(k))
+    out_chunks = []
+    for s in range(stripes.shape[0]):
+        chunks = {i: np.zeros(sinfo.chunk_size, dtype=np.uint8)
+                  for i in range(n_chunks)}
+        for rank, pos in enumerate(data_pos):
+            chunks[pos] = stripes[s, rank].copy()
+        ec_impl.encode_chunks(chunks)
+        out_chunks.append(np.stack([chunks[i] for i in range(n_chunks)]))
+    full = np.stack(out_chunks)
+    # shard i = chunks of all stripes, contiguous (S major)
+    return {i: full[:, i, :].tobytes() for i in sorted(want)}
+
+
+def _encode_framed(sinfo: StripeInfo, ec_impl, stripes, want, k, n_chunks,
+                   mapping, batched) -> dict[int, bytes]:
+    """Inline dispatch of an already-validated frame."""
     with tracer.span("ec_encode") as sp:
         if sp is not None:
-            sp.set_tag("bytes", int(buf.size))
+            sp.set_tag("bytes", int(stripes.size))
             sp.set_tag("k", k)
             sp.set_tag("m", n_chunks - k)
-            sp.set_tag("stripes", n_stripes)
+            sp.set_tag("stripes", stripes.shape[0])
             sp.set_tag("batched", batched)
-        stripes = buf.reshape(n_stripes, k, sinfo.chunk_size)
         if batched:
             parity = np.asarray(ec_impl.encode_stripes(stripes))
-            # shard-major contiguous copies first: .tobytes() on a strided
-            # view falls off numpy's memcpy path (~30x slower — profiled on
-            # the OSD write path)
-            dm = np.ascontiguousarray(stripes.transpose(1, 0, 2))  # (k,S,C)
-            pm = np.ascontiguousarray(parity.transpose(1, 0, 2))   # (m,S,C)
-            return {i: (dm[i] if i < k else pm[i - k]).tobytes()
-                    for i in sorted(want)}
-        else:
-            data_pos = mapping if mapping else list(range(k))
-            out_chunks = []
-            for s in range(n_stripes):
-                chunks = {i: np.zeros(sinfo.chunk_size, dtype=np.uint8)
-                          for i in range(n_chunks)}
-                for rank, pos in enumerate(data_pos):
-                    chunks[pos] = stripes[s, rank].copy()
-                ec_impl.encode_chunks(chunks)
-                out_chunks.append(np.stack([chunks[i]
-                                            for i in range(n_chunks)]))
-            full = np.stack(out_chunks)
-        # shard i = chunks of all stripes, contiguous (S major)
-        return {i: full[:, i, :].tobytes() for i in sorted(want)}
+            return _encode_assemble(stripes, parity, k, want)
+        return _encode_scalar(sinfo, ec_impl, stripes, want, k, n_chunks,
+                              mapping)
 
 
-def _batched_reconstruct(ec_impl, stacked: Mapping[int, np.ndarray],
-                         helpers: list[int], want: list[int]) -> dict[int, np.ndarray]:
-    """One-dispatch reconstruction of `want` shards from per-shard
-    (n, chunk_size) planes via the plugin's decode_stripes batch API.
-    Shared by the degraded-read and shard-recovery paths so the dispatch
-    contract (first-k helper order, (n, k, C) stacking) lives in one place.
+def encode(sinfo: StripeInfo, ec_impl, data: bytes | np.ndarray,
+           want: Iterable[int] | None = None) -> dict[int, bytes]:
+    """Encode a stripe-aligned logical buffer into per-shard buffers.
+
+    Equivalent of ECUtil::encode (ECUtil.cc:134): input length must be a
+    multiple of stripe_width; output maps shard id -> contiguous buffer of
+    one chunk per stripe. One batched device dispatch when the plugin
+    supports it, else the reference's per-stripe loop.
     """
+    stripes, want, k, n_chunks, mapping, batched = _encode_frame(
+        sinfo, ec_impl, data, want)
+    if stripes is None:
+        return {i: b"" for i in sorted(want)}
+    return _encode_framed(sinfo, ec_impl, stripes, want, k, n_chunks,
+                          mapping, batched)
+
+
+async def encode_async(sinfo: StripeInfo, ec_impl,
+                       data: bytes | np.ndarray,
+                       want: Iterable[int] | None = None,
+                       service=None) -> dict[int, bytes]:
+    """encode() through the process-wide offload service: the device
+    dispatch enters the admission queue and coalesces with concurrent
+    callers' stripes (one staged device batch across PGs/daemons)
+    instead of dispatching inline. Without a service — or on a plugin
+    with no batched API — this is exactly encode()."""
+    stripes, want, k, n_chunks, mapping, batched = _encode_frame(
+        sinfo, ec_impl, data, want)
+    if stripes is None:
+        return {i: b"" for i in sorted(want)}
+    if not (batched and service is not None):
+        return _encode_framed(sinfo, ec_impl, stripes, want, k, n_chunks,
+                              mapping, batched)
+    with tracer.span("ec_encode") as sp:
+        if sp is not None:
+            sp.set_tag("bytes", int(stripes.size))
+            sp.set_tag("k", k)
+            sp.set_tag("m", n_chunks - k)
+            sp.set_tag("stripes", stripes.shape[0])
+            sp.set_tag("batched", True)
+            sp.set_tag("offload", True)
+        parity = np.asarray(await service.encode(ec_impl, stripes))
+        return _encode_assemble(stripes, parity, k, want)
+
+
+def _reconstruct_stack(ec_impl, stacked: Mapping[int, np.ndarray],
+                       helpers) -> tuple[tuple[int, ...], np.ndarray]:
+    """The dispatch contract of batched reconstruction, in ONE place
+    (first-k helper order, (n, k, C) stacking) — shared by the inline
+    and offload-service paths of both degraded read and shard
+    recovery."""
     k = ec_impl.get_data_chunk_count()
     use = tuple(helpers[:k])
     if len(use) < k:
         raise ErasureCodeError(
             f"cannot decode: {len(use)} shards available, need {k}")
-    src = np.stack([stacked[i] for i in use], axis=1)       # (n, k, C)
-    rec = np.asarray(ec_impl.decode_stripes(use, tuple(want), src))
+    return use, np.stack([stacked[i] for i in use], axis=1)  # (n, k, C)
+
+
+def _reconstruct_unstack(rec: np.ndarray, want) -> dict[int, np.ndarray]:
     return {wid: rec[:, j, :] for j, wid in enumerate(want)}
 
 
-def decode_concat(sinfo: StripeInfo, ec_impl,
-                  to_decode: Mapping[int, bytes]) -> bytes:
-    """Reconstruct and concatenate the data shards in rank order — the
-    ECUtil::decode concat variant (ECUtil.cc:21-59) feeding degraded reads.
+def _batched_reconstruct(ec_impl, stacked: Mapping[int, np.ndarray],
+                         helpers: list[int], want: list[int]) -> dict[int, np.ndarray]:
+    """One-dispatch reconstruction of `want` shards from per-shard
+    (n, chunk_size) planes via the plugin's decode_stripes batch API."""
+    use, src = _reconstruct_stack(ec_impl, stacked, helpers)
+    rec = np.asarray(ec_impl.decode_stripes(use, tuple(want), src))
+    return _reconstruct_unstack(rec, want)
 
-    `to_decode` maps shard id -> equal-length multi-chunk buffer.
-    """
+
+def _decode_concat_frame(sinfo: StripeInfo, ec_impl,
+                         to_decode: Mapping[int, bytes]):
+    """Shared framing for decode_concat(): validates the shard buffers
+    and resolves the healthy-read case. Returns (done_bytes, work):
+    exactly one is non-None; `work` is (stacked, avail_ids, missing,
+    want, k, n_stripes, mapping)."""
     k = ec_impl.get_data_chunk_count()
-    arrays = {i: np.frombuffer(b, dtype=np.uint8) for i, b in to_decode.items()}
+    arrays = {i: np.frombuffer(b, dtype=np.uint8)
+              for i, b in to_decode.items()}
     if not arrays:
         raise ErasureCodeError("no chunks to decode")
     total = next(iter(arrays.values())).size
@@ -205,7 +268,7 @@ def decode_concat(sinfo: StripeInfo, ec_impl,
             raise ErasureCodeError(f"shard {i} length {a.size} != {total}")
     n_stripes = total // sinfo.chunk_size
     if n_stripes == 0:
-        return b""
+        return b"", None
 
     mapping = ec_impl.get_chunk_mapping()
     want = [mapping[i] if mapping else i for i in range(k)]
@@ -220,10 +283,39 @@ def decode_concat(sinfo: StripeInfo, ec_impl,
         out = np.empty((n_stripes, k, sinfo.chunk_size), dtype=np.uint8)
         for rank, cid in enumerate(want):
             out[:, rank, :] = stacked[cid]
-        return out.tobytes()
+        return out.tobytes(), None
+    return None, (stacked, avail_ids, missing, want, k, n_stripes, mapping)
+
+
+def _decode_concat_assemble(sinfo: StripeInfo, stacked, recovered, want,
+                            k: int, n_stripes: int) -> bytes:
+    out = np.empty((n_stripes, k, sinfo.chunk_size), dtype=np.uint8)
+    for rank, cid in enumerate(want):
+        out[:, rank, :] = stacked[cid] if cid in stacked \
+            else recovered[cid]
+    return out.tobytes()
+
+
+def decode_concat(sinfo: StripeInfo, ec_impl,
+                  to_decode: Mapping[int, bytes]) -> bytes:
+    """Reconstruct and concatenate the data shards in rank order — the
+    ECUtil::decode concat variant (ECUtil.cc:21-59) feeding degraded reads.
+
+    `to_decode` maps shard id -> equal-length multi-chunk buffer.
+    """
+    done, work = _decode_concat_frame(sinfo, ec_impl, to_decode)
+    if done is not None:
+        return done
+    return _decode_concat_framed(sinfo, ec_impl, work)
+
+
+def _decode_concat_framed(sinfo: StripeInfo, ec_impl, work) -> bytes:
+    """Inline reconstruction of an already-validated frame."""
+    stacked, avail_ids, missing, want, k, n_stripes, mapping = work
     with tracer.span("ec_decode") as sp:
         if sp is not None:
-            sp.set_tag("bytes", int(total) * len(arrays))
+            sp.set_tag("bytes", int(n_stripes * sinfo.chunk_size
+                                    * len(stacked)))
             sp.set_tag("k", k)
             sp.set_tag("missing", missing)
             sp.set_tag("stripes", n_stripes)
@@ -231,12 +323,8 @@ def decode_concat(sinfo: StripeInfo, ec_impl,
                 and not mapping:
             recovered = _batched_reconstruct(ec_impl, stacked, avail_ids,
                                              missing)
-            out = np.empty((n_stripes, k, sinfo.chunk_size),
-                           dtype=np.uint8)
-            for rank, cid in enumerate(want):
-                out[:, rank, :] = stacked[cid] if cid in stacked \
-                    else recovered[cid]
-            return out.tobytes()
+            return _decode_concat_assemble(sinfo, stacked, recovered,
+                                           want, k, n_stripes)
 
         # per-stripe fallback through the scalar contract (reference loop)
         parts = []
@@ -246,18 +334,42 @@ def decode_concat(sinfo: StripeInfo, ec_impl,
         return b"".join(parts)
 
 
-def decode_shards(sinfo: StripeInfo, ec_impl, to_decode: Mapping[int, bytes],
-                  need: Iterable[int]) -> dict[int, bytes]:
-    """Reconstruct whole shards (data or parity) — the per-shard
-    ECUtil::decode variant (ECUtil.cc:61-131) used by shard recovery.
+async def decode_concat_async(sinfo: StripeInfo, ec_impl,
+                              to_decode: Mapping[int, bytes],
+                              service=None) -> bytes:
+    """decode_concat() with the reconstruction dispatch routed through
+    the offload service (degraded reads coalesce across PGs when they
+    share an erasure pattern). Healthy reads never touch the device and
+    return synchronously either way."""
+    done, work = _decode_concat_frame(sinfo, ec_impl, to_decode)
+    if done is not None:
+        return done
+    stacked, avail_ids, missing, want, k, n_stripes, mapping = work
+    if not (service is not None and not mapping
+            and callable(getattr(ec_impl, "decode_stripes", None))):
+        return _decode_concat_framed(sinfo, ec_impl, work)
+    with tracer.span("ec_decode") as sp:
+        if sp is not None:
+            sp.set_tag("k", k)
+            sp.set_tag("missing", missing)
+            sp.set_tag("stripes", n_stripes)
+            sp.set_tag("offload", True)
+        use, src = _reconstruct_stack(ec_impl, stacked, avail_ids)
+        rec = np.asarray(await service.decode(ec_impl, use,
+                                              tuple(missing), src))
+        recovered = _reconstruct_unstack(rec, missing)
+        return _decode_concat_assemble(sinfo, stacked, recovered, want,
+                                       k, n_stripes)
 
-    `to_decode` holds the shard buffers fetched per minimum_to_decode
-    (possibly sub-chunk fragments: each shard buffer contains
-    repair_data_per_chunk bytes per chunk); `need` lists shard ids to
-    rebuild. Returns full-size rebuilt shards.
-    """
-    need = sorted(set(need))
-    arrays = {i: np.frombuffer(b, dtype=np.uint8) for i, b in to_decode.items()}
+
+def _decode_shards_frame(sinfo: StripeInfo, ec_impl,
+                         to_decode: Mapping[int, bytes], need: list[int]):
+    """Shared repair-plan validation for decode_shards(): returns
+    (arrays, helpers, plan_counts, sub, repair_per_chunk, n_chunks) —
+    one copy, so plan-contract fixes (like the ADVICE-r2 homogeneity
+    guard) apply to the inline and offload paths alike."""
+    arrays = {i: np.frombuffer(b, dtype=np.uint8)
+              for i, b in to_decode.items()}
     if not arrays:
         raise ErasureCodeError("no chunks to decode")
     minimum = ec_impl.minimum_to_decode(need, set(arrays))
@@ -287,7 +399,57 @@ def decode_shards(sinfo: StripeInfo, ec_impl, to_decode: Mapping[int, bytes],
     total = sizes.pop()
     if total % repair_per_chunk:
         raise ErasureCodeError("shard buffer not aligned to repair unit")
-    n_chunks = total // repair_per_chunk
+    return arrays, helpers, plan_counts, sub, repair_per_chunk, \
+        total // repair_per_chunk
+
+
+async def decode_shards_async(sinfo: StripeInfo, ec_impl,
+                              to_decode: Mapping[int, bytes],
+                              need: Iterable[int],
+                              service=None) -> dict[int, bytes]:
+    """decode_shards() with the whole-chunk batched repair dispatch
+    routed through the offload service. Sub-chunk (CLAY) and mapped
+    plugins keep the inline path — their repair plans don't stack into
+    the service's (n, k, C) job shape."""
+    need_l = sorted(set(need))
+    if not (service is not None
+            and ec_impl.get_sub_chunk_count() == 1
+            and not ec_impl.get_chunk_mapping()
+            and callable(getattr(ec_impl, "decode_stripes", None))):
+        return decode_shards(sinfo, ec_impl, to_decode, need_l)
+    arrays, helpers, _plan, _sub, _rpc, n_chunks = _decode_shards_frame(
+        sinfo, ec_impl, to_decode, need_l)
+    if n_chunks == 0:
+        return decode_shards(sinfo, ec_impl, to_decode, need_l)
+    with tracer.span("ec_recover") as sp:
+        if sp is not None:
+            sp.set_tag("need", need_l)
+            sp.set_tag("helpers", helpers)
+            sp.set_tag("chunks", n_chunks)
+            sp.set_tag("offload", True)
+        stacked = {i: arrays[i].reshape(n_chunks, sinfo.chunk_size)
+                   for i in helpers}
+        use, src = _reconstruct_stack(ec_impl, stacked, helpers)
+        rec = np.asarray(await service.decode(ec_impl, use, tuple(need_l),
+                                              src))
+        return {nid: np.ascontiguousarray(plane).tobytes()
+                for nid, plane in
+                _reconstruct_unstack(rec, need_l).items()}
+
+
+def decode_shards(sinfo: StripeInfo, ec_impl, to_decode: Mapping[int, bytes],
+                  need: Iterable[int]) -> dict[int, bytes]:
+    """Reconstruct whole shards (data or parity) — the per-shard
+    ECUtil::decode variant (ECUtil.cc:61-131) used by shard recovery.
+
+    `to_decode` holds the shard buffers fetched per minimum_to_decode
+    (possibly sub-chunk fragments: each shard buffer contains
+    repair_data_per_chunk bytes per chunk); `need` lists shard ids to
+    rebuild. Returns full-size rebuilt shards.
+    """
+    need = sorted(set(need))
+    arrays, helpers, plan_counts, sub, repair_per_chunk, n_chunks = \
+        _decode_shards_frame(sinfo, ec_impl, to_decode, need)
 
     with tracer.span("ec_recover") as sp:
         if sp is not None:

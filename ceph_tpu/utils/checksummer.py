@@ -81,6 +81,36 @@ class Checksummer:
             csums = csums & ((1 << bits) - 1)
         return csums
 
+    async def calculate_async(self, data, service=None) -> np.ndarray:
+        """calculate() with the per-block crc batch submitted through
+        the process-wide offload service: the blocks coalesce with
+        concurrent callers (EC shard csums, other checksummers) into one
+        CrcJob and the work leaves the event loop. Falls back to the
+        inline path without a service, for non-batchable buffers, or
+        when the type is none."""
+        import jax
+
+        if service is None or self.csum_type == CSUM_NONE \
+                or isinstance(data, jax.Array):
+            return self.calculate(data)
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            arr = np.frombuffer(data, dtype=np.uint8)
+        else:
+            arr = np.ascontiguousarray(data, dtype=np.uint8).reshape(-1)
+        if arr.size % self.block_size:
+            raise ValueError(
+                f"buffer size {arr.size} not a multiple of csum block "
+                f"{self.block_size}")
+        if arr.size == 0:
+            return np.zeros(0, dtype=np.uint32)
+        blocks = arr.reshape(-1, self.block_size)
+        csums = np.asarray(await service.crc32c_blocks(blocks,
+                                                       self.block_size))
+        bits = _VALUE_BITS[self.csum_type]
+        if bits < 32:
+            csums = csums & ((1 << bits) - 1)
+        return csums
+
     def verify(self, data: bytes | np.ndarray,
                expected: np.ndarray) -> int:
         """Returns -1 if all blocks match, else the byte offset of the
