@@ -189,6 +189,16 @@ def main() -> int:
         out["error"] = ("tpu backend did not come up inside the "
                         f"{DEVICE_TIMEOUT}s long-warm device child; device "
                         "numbers are the hermetic cpu-jax fallback")
+    # bench trend guard: compare device codec GB/s against the newest
+    # committed BENCH_r*.json so a silent slide (the r4->r5 35.2->31.96
+    # encode drop) becomes a loud regression_pct the round it happens
+    from ceph_tpu.tools.bench_driver import trend_guard
+    trend = trend_guard(detail, out["platform"], REPO)
+    if trend is not None:
+        out["trend"] = trend
+        out["regression_pct"] = trend.get("regression_pct", 0.0)
+        if "warning" in trend:
+            sys.stderr.write("bench trend: " + trend["warning"] + "\n")
     # per-stage wall-clock breakdown from the stage spans
     spans = [s for s in tracer.collector().spans()
              if s["name"].startswith("bench:")]

@@ -92,7 +92,8 @@ class MDSDaemon(Dispatcher):
                              or {}).get("active_addr"),
             status_cb=lambda: {"metadata_pool": self.metadata_pool,
                                "data_pool": self.data_pool,
-                               "journal_seq": self._journal_seq})
+                               "journal_seq": self._journal_seq},
+            extra_loggers=("sanitizer",))
 
     # -- lifecycle -----------------------------------------------------------
 

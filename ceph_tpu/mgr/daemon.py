@@ -217,14 +217,10 @@ class MgrDaemon(Dispatcher):
                 if self.exporter else "(no exporter)"))
 
     async def stop(self) -> None:
-        import contextlib
+        from ceph_tpu.utils.async_util import reap
         for attr in ("_tick_task", "_beacon_task"):
-            task = getattr(self, attr)
-            if task is not None:
-                task.cancel()
-                with contextlib.suppress(asyncio.CancelledError):
-                    await task
-                setattr(self, attr, None)
+            await reap(getattr(self, attr))
+            setattr(self, attr, None)
         if self.exporter is not None:
             await self.exporter.stop()
         await self.monc.close()

@@ -77,10 +77,8 @@ class MgrClient(Dispatcher):
 
     async def stop(self) -> None:
         if self._task is not None:
-            self._task.cancel()
-            import contextlib
-            with contextlib.suppress(asyncio.CancelledError):
-                await self._task
+            from ceph_tpu.utils.async_util import reap
+            await reap(self._task)
             self._task = None
         if self._conn is not None:
             await self._conn.close()

@@ -39,6 +39,7 @@ from ceph_tpu.msg.messages import (MLog, Message, MMgrMap, MMonCommand,
                                    MOSDFailure, MOSDMapMsg, MPing,
                                    MPingReply)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
+from ceph_tpu.utils.async_util import reap, reap_all
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import PerfCountersCollection
 
@@ -450,6 +451,10 @@ class Monitor(Dispatcher):
         # the active mgr by push, never by polling commands)
         self.mgr_subs: dict[Connection, int] = {}
         self._tick_task: asyncio.Task | None = None
+        # in-flight background proposals (_spawn_proposal): tracked so
+        # stop() can reap them — a detached proposal task left pending
+        # at loop close is the monitor's own _dispatch_loop leak
+        self._proposal_tasks: set[asyncio.Task] = set()
         self._applied = 0      # last paxos version applied to services
         # cluster log (LogMonitor-lite, src/mon/LogMonitor.cc): WARN+
         # events from daemons (MLog) and this mon's own map-change
@@ -479,7 +484,8 @@ class Monitor(Dispatcher):
                 "quorum": sorted(self.paxos.quorum),
                 "osdmap_epoch": self.osdmon.osdmap.epoch,
                 "applied_version": self._applied},
-            perf_name=f"mon.{name}")
+            perf_name=f"mon.{name}",
+            extra_loggers=("sanitizer",))
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -498,12 +504,9 @@ class Monitor(Dispatcher):
         return addr
 
     async def stop(self) -> None:
-        if self._tick_task:
-            self._tick_task.cancel()
-            try:
-                await self._tick_task
-            except (asyncio.CancelledError, Exception):
-                pass
+        await reap(self._tick_task)
+        await reap_all(list(self._proposal_tasks))
+        self._proposal_tasks.clear()
         await self.mgr_client.stop()
         await self.paxos.stop()
         await self.messenger.shutdown()
@@ -598,15 +601,18 @@ class Monitor(Dispatcher):
             self._spawn_proposal()
 
     def _spawn_proposal(self) -> None:
-        """Fire-and-forget propose_pending with failures logged, never
-        raised into the event loop."""
+        """Background propose_pending with failures logged, never
+        raised into the event loop; the handle is tracked so stop()
+        reaps any proposal still in flight."""
         async def run():
             try:
                 await self.osdmon.propose_pending()
             except Exception as e:
                 dout("mon", 5, f"mon.{self.name}: background proposal "
                                f"failed: {type(e).__name__} {e}")
-        asyncio.get_running_loop().create_task(run())
+        task = asyncio.get_running_loop().create_task(run())
+        self._proposal_tasks.add(task)
+        task.add_done_callback(self._proposal_tasks.discard)
 
     # -- dispatch ------------------------------------------------------------
 

@@ -29,6 +29,7 @@ from typing import Awaitable, Callable
 
 from ceph_tpu.msg.messages import MMonElection, MMonPaxos
 from ceph_tpu.msg.messenger import Connection, Messenger, Policy
+from ceph_tpu.utils.async_util import reap_all
 from ceph_tpu.utils.dout import dout
 
 
@@ -116,13 +117,7 @@ class Paxos:
 
     async def stop(self) -> None:
         self._started = False
-        for t in list(self._tasks):
-            t.cancel()
-        for t in list(self._tasks):
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+        await reap_all(list(self._tasks))
 
     async def _tick(self) -> None:
         while True:

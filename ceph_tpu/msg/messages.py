@@ -116,7 +116,9 @@ MMonPaxos = _simple(0x27, "MMonPaxos")            # {"op": collect|last|begin|
 
 # -- osd control plane -------------------------------------------------------
 MOSDBoot = _simple(0x30, "MOSDBoot")              # {"osd": id, "addr": str}
-MOSDAlive = _simple(0x31, "MOSDAlive")
+# 0x31 reserved: MOSDAlive (up_thru advance) — declared-but-dead wire
+# protocol until an up_thru analog exists; see radoslint
+# registry-consistency
 MOSDFailure = _simple(0x32, "MOSDFailure")        # {"failed": id, "from": id}
 
 # -- client I/O (MOSDOp/MOSDOpReply, src/messages/MOSDOp.h) ------------------

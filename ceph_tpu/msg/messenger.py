@@ -36,6 +36,8 @@ from typing import Awaitable, Callable
 from ceph_tpu.msg.frames import BANNER, Frame, FrameError, Tag, Onwire
 from ceph_tpu.msg.messages import Message
 from ceph_tpu.utils import tracer
+from ceph_tpu.utils.async_util import being_cancelled, drain_all, reap, \
+    reap_all
 from ceph_tpu.utils.dout import dout
 
 
@@ -174,13 +176,7 @@ class Connection:
     async def close(self) -> None:
         self._closed = True
         tasks = list(self._tasks)   # done-callbacks mutate _tasks
-        for t in tasks:
-            t.cancel()
-        for t in tasks:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+        await reap_all(tasks)
         self._tasks.clear()
         await self._close_transport()
 
@@ -200,8 +196,10 @@ class Connection:
             except asyncio.CancelledError:
                 # asyncio.streams can cancel the close waiter internally
                 # when the transport dies mid-close; only propagate when
-                # OUR task is actually being cancelled
-                if asyncio.current_task().cancelling():
+                # OUR task is actually being cancelled (being_cancelled
+                # degrades safely on 3.10, where Task.cancelling() does
+                # not exist — the old direct call raised AttributeError)
+                if being_cancelled():
                     raise
             except Exception:
                 pass
@@ -350,12 +348,7 @@ class Connection:
             # every messenger table, so shutdown() can no longer reach
             # it and an unreaped task leaks ("Task was destroyed but it
             # is pending!" at loop teardown, seen in BENCH_r05)
-            if not dispatch.done():
-                dispatch.cancel()
-                try:
-                    await dispatch
-                except (asyncio.CancelledError, Exception):
-                    pass
+            await reap(dispatch)
 
     async def _run_inner(self) -> None:
         backoff = self.RECONNECT_BACKOFF
@@ -389,7 +382,9 @@ class Connection:
             gen = self._gen
             try:
                 await self._pump()
-            except (asyncio.CancelledError, GeneratorExit):
+            except asyncio.CancelledError:
+                raise               # session reaped: unwind through _run
+            except GeneratorExit:
                 return
             except Exception as e:
                 dout("ms", 5, f"{self} transport fault: {type(e).__name__} {e}")
@@ -410,13 +405,7 @@ class Connection:
             done, pending = await asyncio.wait(
                 tasks, return_when=asyncio.FIRST_EXCEPTION)
         finally:
-            for t in tasks:
-                t.cancel()
-            for t in tasks:
-                try:
-                    await t
-                except (asyncio.CancelledError, Exception):
-                    pass
+            await reap_all(tasks)
         for t in done:
             exc = t.exception()
             if exc is not None:
@@ -788,13 +777,11 @@ class Messenger:
         self._conns.clear()
         self._accepted.clear()
         self._sessions.clear()
-        # reap detached close tasks: every connection task must be DONE
-        # when shutdown returns, or loop teardown destroys them pending
-        for task in list(self._bg_tasks):
-            try:
-                await task
-            except (asyncio.CancelledError, Exception):
-                pass
+        # drain detached close tasks (no cancel: a half-run close() may
+        # leave a transport dangling) — every connection task must be
+        # DONE when shutdown returns, or loop teardown destroys them
+        # pending
+        await drain_all(list(self._bg_tasks))
         self._bg_tasks.clear()
         if self._server is not None:
             await self._server.wait_closed()

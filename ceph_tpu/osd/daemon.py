@@ -33,8 +33,9 @@ from ceph_tpu.objectstore.memstore import MemStore
 from ceph_tpu.objectstore.store import StoreError
 from ceph_tpu.osd.backend import IntervalChange
 from ceph_tpu.osd.pg import PGInstance
-from ceph_tpu.utils import tracer
+from ceph_tpu.utils import sanitizer, tracer
 from ceph_tpu.utils.admin_socket import AdminSocket
+from ceph_tpu.utils.async_util import reap_all
 from ceph_tpu.utils.config import Config, Option
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import (TYPE_AVG, TYPE_HISTOGRAM,
@@ -96,6 +97,10 @@ class OSD(Dispatcher):
         # batcher live via the config observer
         from ceph_tpu import offload
         offload.register_config(self.config)
+        # runtime asyncio sanitizer (debug mode + slow-callback log +
+        # task spawn-site tracking): `config set sanitizer_enabled
+        # true` arms the running loop live
+        sanitizer.register_config(self.config)
         # per-daemon perf counters, served by `perf dump` (the admin
         # socket reads the process-wide collection)
         coll = PerfCountersCollection.instance()
@@ -181,7 +186,7 @@ class OSD(Dispatcher):
             status_cb=self._daemon_status,
             health_cb=self._mgr_health_metrics,
             progress_cb=self._mgr_progress,
-            extra_loggers=("offload",))
+            extra_loggers=("offload", "sanitizer"))
         # the per-loop offload service handle (set at start(): the
         # admin-socket thread cannot resolve the running loop itself)
         self._offload_svc = None
@@ -230,6 +235,7 @@ class OSD(Dispatcher):
             self.store.mount()
         from ceph_tpu import offload
         self._offload_svc = offload.get_service()
+        sanitizer.maybe_install(self.config)
         self.op_queue.start()
         self.finisher.start()
         if self.asok is not None:
@@ -385,12 +391,7 @@ class OSD(Dispatcher):
         # background + detached-notify tasks too: anything left pending
         # when the loop closes is destroyed (messenger leak's sibling)
         bg += list(self._bg_tasks) + list(self._notify_tasks)
-        for task in bg:
-            task.cancel()
-            try:
-                await task
-            except (asyncio.CancelledError, Exception):
-                pass
+        await reap_all(bg)
         self._bg_tasks.clear()
         self._notify_tasks.clear()
         for pg in self.pgs.values():

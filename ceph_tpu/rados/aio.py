@@ -106,7 +106,13 @@ class AioDispatcher:
                     await self._throttle.acquire()
                     acquired = True
                     comp._finish(await coro)
-            except BaseException as e:
+            except asyncio.CancelledError as e:
+                # record the op as failed, then PROPAGATE: swallowing
+                # here made flush()/teardown cancellation a silent no-op
+                # (the task kept running to loop close)
+                comp._finish(error=e)
+                raise
+            except Exception as e:
                 comp._finish(error=e)
             finally:
                 if acquired:

@@ -31,7 +31,10 @@ Each stage prints exactly one JSON line on stdout; logs go to stderr.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
+import re
 import sys
 import time
 
@@ -424,6 +427,88 @@ def stage_cluster_tpu() -> dict:
     asyncio.run(asyncio.wait_for(datapath(), 120))
     results["elapsed_s"] = round(_t.perf_counter() - t0, 1)
     return results
+
+
+# -- bench trend guard --------------------------------------------------------
+# The r4->r5 device encode number slid 35.2 -> 31.96 GB/s and nothing
+# noticed until a human diffed the JSON by hand (VERDICT weak #5). The
+# guard compares each run's device codec numbers against the newest
+# committed BENCH_r*.json and embeds the verdict in the output line, so
+# a silent slide becomes a loud `regression_pct` the round it happens.
+
+TREND_KEYS = ("tpu_encode", "tpu_decode")
+TREND_THRESHOLD_PCT = 10.0
+
+
+def previous_bench(repo: str) -> tuple[str, str | None, dict] | None:
+    """Newest committed round: (filename, platform, detail-metrics).
+
+    BENCH_r*.json wraps the bench line under "parsed" (driver format);
+    a bare bench.py line is accepted too. Unreadable/garbled files are
+    skipped rather than failing the bench."""
+    rounds: list[tuple[int, str]] = []
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            rounds.append((int(m.group(1)), path))
+    # newest first, falling back past garbled/failed rounds (a failed
+    # round commits "parsed": null) so one bad file cannot disarm the
+    # guard for the round after it
+    for _, path in sorted(rounds, reverse=True):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(data, dict):
+            continue
+        parsed = data.get("parsed", data)
+        if isinstance(parsed, dict) and isinstance(parsed.get("detail"),
+                                                   dict):
+            return (os.path.basename(path), parsed.get("platform"),
+                    parsed["detail"])
+    return None
+
+
+def trend_guard(detail: dict, platform: str | None, repo: str,
+                threshold_pct: float = TREND_THRESHOLD_PCT) -> dict | None:
+    """Compare this run's device encode/decode GB/s with the previous
+    round. Returns the trend record for the JSON line: per-key
+    prev/now/regression_pct, the worst regression as `regression_pct`,
+    and a `warning` when the drop exceeds `threshold_pct`. None when no
+    prior round exists; comparison is skipped (recorded, not silent)
+    when the platform changed — cpu-fallback vs tpu GB/s is noise, not
+    a regression."""
+    prev = previous_bench(repo)
+    if prev is None:
+        return None
+    prev_name, prev_platform, prev_detail = prev
+    trend: dict = {"baseline_round": prev_name,
+                   "threshold_pct": threshold_pct}
+    if prev_platform != platform:
+        trend["skipped"] = (f"platform changed "
+                            f"({prev_platform} -> {platform}): device "
+                            f"GB/s not comparable across backends")
+        return trend
+    deltas: dict = {}
+    worst_pct, worst_key = 0.0, None
+    for key in TREND_KEYS:
+        now, old = detail.get(key) or 0.0, prev_detail.get(key) or 0.0
+        if not now or not old:
+            continue            # one side unmeasured: nothing to judge
+        pct = round((old - now) / old * 100.0, 2)
+        deltas[key] = {"prev": old, "now": now, "regression_pct": pct}
+        if pct > worst_pct:
+            worst_pct, worst_key = pct, key
+    trend["deltas"] = deltas
+    trend["regression_pct"] = worst_pct
+    if worst_key is not None and worst_pct > threshold_pct:
+        d = deltas[worst_key]
+        trend["warning"] = (
+            f"{worst_key} dropped {worst_pct}% vs {prev_name} "
+            f"({d['prev']} -> {d['now']} GB/s, threshold "
+            f"{threshold_pct}%) — bisect before merging")
+    return trend
 
 
 def main() -> int:

@@ -28,6 +28,7 @@ import json
 import sys
 
 from ceph_tpu.mds import CephFS
+from ceph_tpu.utils.async_util import read_file, write_file
 
 
 MIN_OPERANDS = {"ls": 0, "mkdir": 1, "rmdir": 1, "put": 2, "get": 2,
@@ -66,15 +67,14 @@ async def _run(args) -> int:
             await fs.rmdir(rest[0])
         elif cmd == "put":
             blob = sys.stdin.buffer.read() if rest[0] == "-" else \
-                open(rest[0], "rb").read()
+                await read_file(rest[0])
             await fs.write_file(rest[1], blob)
         elif cmd in ("get", "cat"):
             data = await fs.read_file(rest[0])
             if cmd == "cat" or rest[1] == "-":
                 sys.stdout.buffer.write(data)
             else:
-                with open(rest[1], "wb") as f:
-                    f.write(data)
+                await write_file(rest[1], data)
         elif cmd == "rm":
             await fs.unlink(rest[0])
         elif cmd == "mv":

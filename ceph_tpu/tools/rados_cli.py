@@ -31,6 +31,7 @@ import json
 import sys
 
 from ceph_tpu.rados import RadosClient
+from ceph_tpu.utils.async_util import read_file, write_file
 
 
 MIN_OPERANDS = {"ls": 0, "put": 2, "get": 2, "rm": 1, "stat": 1,
@@ -119,7 +120,7 @@ async def _run(args) -> int:
             elif cmd == "put":
                 oid, path = args.cmd[1], args.cmd[2]
                 data = sys.stdin.buffer.read() if path == "-" else \
-                    open(path, "rb").read()
+                    await read_file(path)
                 await io.write_full(oid, data)
                 print(f"wrote {len(data)} bytes to {oid}")
             elif cmd == "get":
@@ -128,7 +129,7 @@ async def _run(args) -> int:
                 if path == "-":
                     sys.stdout.buffer.write(data)
                 else:
-                    open(path, "wb").write(data)
+                    await write_file(path, data)
                     print(f"read {len(data)} bytes from {oid}")
             elif cmd == "rm":
                 await io.remove(args.cmd[1])

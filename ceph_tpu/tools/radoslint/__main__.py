@@ -1,0 +1,5 @@
+import sys
+
+from ceph_tpu.tools.radoslint.cli import main
+
+sys.exit(main())

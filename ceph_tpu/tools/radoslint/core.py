@@ -1,0 +1,257 @@
+"""radoslint core: finding model, suppressions, baseline, rule registry.
+
+The lockdep-shaped half of the reference's race tooling
+(src/common/lockdep.cc) enforces ordering invariants at runtime; this
+suite enforces the asyncio equivalents *statically*, before the code
+ever runs. The machinery is deliberately small:
+
+  * `Finding` — one defect at `path:line:rule-id`, rendered human or
+    JSON; `key` is the stable identity the baseline stores.
+  * suppressions — `# radoslint: disable=<rule>[,rule]` on the line (or
+    any line of a multi-line statement), `disable-next=` for the line
+    below, `disable-file=` anywhere for the whole module. `all` matches
+    every rule. Suppressions are for *justified* exceptions; new code
+    should fix, not disable.
+  * baseline — a committed JSON list of grandfathered finding keys.
+    `--write-baseline` regenerates it; the CI gate fails on any finding
+    not in it, so the file can only shrink (ratchet, not whitelist).
+  * rules — registered by the checker modules; `kind` is "file" (pure
+    per-module AST visit) or "project" (needs the whole file set, e.g.
+    registry cross-checks).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import subprocess
+from typing import Callable, Iterable
+
+BASELINE_NAME = ".radoslint-baseline.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str                  # root-relative posix path
+    line: int
+    rule: str
+    message: str
+    end_line: int = 0          # suppression range only; not identity
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}:{self.line}:{self.rule}: {self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "message": self.message}
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*radoslint:\s*(disable(?:-next|-file)?)=([A-Za-z0-9_\-, ]+)")
+
+
+class SourceFile:
+    """One parsed module plus its suppression map."""
+
+    def __init__(self, abspath: str, path: str, source: str):
+        self.abspath = abspath
+        self.path = path            # root-relative, posix separators
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.file_disables: set[str] = set()
+        self.line_disables: dict[int, set[str]] = {}
+        for lineno, text in enumerate(source.splitlines(), 1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            mode, rules = m.group(1), {
+                r.strip() for r in m.group(2).split(",") if r.strip()}
+            if mode == "disable-file":
+                self.file_disables |= rules
+            elif mode == "disable-next":
+                self.line_disables.setdefault(lineno + 1, set()).update(rules)
+            else:
+                self.line_disables.setdefault(lineno, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int, end_line: int = 0) -> bool:
+        if {"all", rule} & self.file_disables:
+            return True
+        for ln in range(line, max(end_line, line) + 1):
+            if {"all", rule} & self.line_disables.get(ln, set()):
+                return True
+        return False
+
+
+class Rule:
+    """One registered checker. file rules get a SourceFile per call;
+    project rules get the whole list once."""
+
+    def __init__(self, rule_id: str, kind: str, doc: str, fn: Callable):
+        assert kind in ("file", "project")
+        self.id = rule_id
+        self.kind = kind
+        self.doc = doc
+        self.fn = fn
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(rule_id: str, kind: str, doc: str):
+    """Decorator registering a checker function as a rule."""
+    def wrap(fn):
+        RULES[rule_id] = Rule(rule_id, kind, doc, fn)
+        return fn
+    return wrap
+
+
+# -- file collection ---------------------------------------------------------
+
+def collect_files(paths: Iterable[str], root: str) -> list[SourceFile]:
+    """Load every .py under `paths` (files or directories) as
+    SourceFiles with root-relative display paths. Unparseable files
+    become a synthetic `parse-error` finding via run_lint."""
+    seen: dict[str, str] = {}
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            seen[p] = p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__" and not d.startswith(".")]
+            for f in filenames:
+                if f.endswith(".py"):
+                    ap = os.path.join(dirpath, f)
+                    seen[ap] = ap
+    out = []
+    for ap in sorted(seen):
+        rel = os.path.relpath(ap, root).replace(os.sep, "/")
+        with open(ap, "r", encoding="utf-8") as fh:
+            out.append((ap, rel, fh.read()))
+    return out
+
+
+def git_changed_files(root: str) -> set[str] | None:
+    """Root-relative paths touched vs HEAD (worktree + index +
+    untracked); None when git is unavailable (fail open: lint all).
+
+    `git diff --name-only` reports paths relative to the repo
+    TOP-LEVEL while findings are relative to `root` (which may be a
+    subdirectory), so every reported path is re-anchored; entries
+    outside `root` are dropped."""
+    changed: set[str] = set()
+    try:
+        top = subprocess.run(["git", "rev-parse", "--show-toplevel"],
+                             cwd=root, capture_output=True, text=True,
+                             timeout=30)
+        if top.returncode != 0:
+            return None
+        toplevel = top.stdout.strip()
+        # --others is cwd-relative, diff is toplevel-relative: anchor
+        # each listing at the directory git resolves it against
+        for args, base in (
+                (["git", "diff", "--name-only", "HEAD"], toplevel),
+                (["git", "ls-files", "--others", "--exclude-standard"],
+                 root)):
+            res = subprocess.run(args, cwd=root, capture_output=True,
+                                 text=True, timeout=30)
+            if res.returncode != 0:
+                return None
+            for ln in res.stdout.splitlines():
+                ln = ln.strip()
+                if not ln:
+                    continue
+                rel = os.path.relpath(os.path.join(base, ln), root)
+                if not rel.startswith(".."):
+                    changed.add(rel.replace(os.sep, "/"))
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return changed
+
+
+# -- baseline ----------------------------------------------------------------
+
+def find_baseline(start: str) -> str | None:
+    """Walk upward from `start` for a committed baseline file."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        cand = os.path.join(d, BASELINE_NAME)
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def load_baseline(path: str) -> set[str]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return set(data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Iterable[Finding | str]) -> int:
+    """Accepts Finding objects or pre-rendered baseline keys."""
+    keys = sorted(f.key if isinstance(f, Finding) else str(f)
+                  for f in findings)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"tool": "radoslint", "version": 1, "findings": keys},
+                  f, indent=1, sort_keys=True)
+        f.write("\n")
+    return len(keys)
+
+
+# -- driver ------------------------------------------------------------------
+
+def run_lint(paths: Iterable[str], root: str | None = None,
+             rules: Iterable[str] | None = None,
+             changed_only: bool = False) -> list[Finding]:
+    """Run the suite: per-file rules on each module (restricted to
+    changed files in changed-only mode), then project rules over the
+    full set (cross-file consistency needs the whole picture even for
+    an incremental run). Suppressions apply to both."""
+    # load the checker modules so their @rule decorators run
+    from ceph_tpu.tools.radoslint import checkers, project  # noqa: F401
+    root = os.path.abspath(root or os.getcwd())
+    wanted = set(rules) if rules is not None else set(RULES)
+    unknown = wanted - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+    raw = collect_files(paths, root)
+    files: list[SourceFile] = []
+    findings: list[Finding] = []
+    for ap, rel, src in raw:
+        try:
+            files.append(SourceFile(ap, rel, src))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 0, "parse-error",
+                                    f"cannot parse: {e.msg}"))
+    changed = git_changed_files(root) if changed_only else None
+    per_file = files if changed is None else \
+        [sf for sf in files if sf.path in changed]
+    by_path = {sf.path: sf for sf in files}
+    for r in RULES.values():
+        if r.id not in wanted:
+            continue
+        if r.kind == "file":
+            for sf in per_file:
+                findings.extend(r.fn(sf))
+        else:
+            findings.extend(r.fn(files))
+    out = []
+    for f in findings:
+        sf = by_path.get(f.path)
+        if sf is not None and sf.suppressed(f.rule, f.line, f.end_line):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return out

@@ -33,6 +33,7 @@ import sys
 
 from ceph_tpu.rados import RadosClient
 from ceph_tpu.rbd.image import DEFAULT_ORDER, RBD, Image
+from ceph_tpu.utils.async_util import read_file
 
 MB = 1 << 20
 
@@ -97,15 +98,19 @@ async def _run(args) -> int:
                 await img.close()
         elif cmd == "export":
             img = await Image.open(io, rest[0])
+            loop = asyncio.get_running_loop()
             out = sys.stdout.buffer if rest[1] == "-" else \
-                open(rest[1], "wb")
+                await loop.run_in_executor(None, open, rest[1], "wb")
             try:
                 # stream object-size chunks (the reference rbd export
-                # does the same) instead of one whole-image buffer
+                # does the same) instead of one whole-image buffer;
+                # file writes go through the executor so a slow disk
+                # cannot stall the image reads' event loop
                 off = 0
                 while off < img.size:
                     n = min(img.object_size, img.size - off)
-                    out.write(await img.read(off, n))
+                    chunk = await img.read(off, n)
+                    await loop.run_in_executor(None, out.write, chunk)
                     off += n
             finally:
                 if out is not sys.stdout.buffer:
@@ -113,7 +118,7 @@ async def _run(args) -> int:
                 await img.close()
         elif cmd == "import":
             blob = sys.stdin.buffer.read() if rest[0] == "-" else \
-                open(rest[0], "rb").read()
+                await read_file(rest[0])
             await RBD.create(io, rest[1], len(blob),
                              order=args.order or DEFAULT_ORDER)
             img = await Image.open(io, rest[1])

@@ -1,0 +1,110 @@
+"""Shared asyncio lifecycle helpers: cancellation-correct task reaping.
+
+Every daemon in this stack ends the same way: cancel background tasks,
+await them, swallow the expected CancelledError. Hand-rolled versions of
+that dance keep re-growing the same two bugs radoslint's
+cancellation-swallow rule exists for:
+
+  * `except (asyncio.CancelledError, Exception): pass` swallows OUR OWN
+    cancellation too — a teardown coroutine that is itself cancelled
+    (test timeout, parent daemon dying) silently keeps running instead
+    of unwinding, which is exactly how half-dead daemons linger;
+  * `Task.cancelling()` is 3.11+; calling it on 3.10 raises
+    AttributeError from inside the except handler (seen latent in the
+    messenger's transport close path).
+
+`reap()` centralizes the correct version: cancel the task, await it,
+swallow only the CancelledError that belongs to the reaped task, and
+re-raise when the *current* task is the one being cancelled.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Iterable
+
+
+def being_cancelled() -> bool:
+    """True when the current task has a pending cancellation request.
+
+    Uses Task.cancelling() on 3.11+; on 3.10 there is no reliable
+    signal, so this degrades to False (matching the historical swallow
+    behavior instead of crashing on a missing attribute)."""
+    task = asyncio.current_task()
+    if task is None:
+        return False
+    cancelling = getattr(task, "cancelling", None)
+    if cancelling is None:
+        return False
+    return bool(cancelling())
+
+
+async def reap(task: asyncio.Task | None) -> None:
+    """Cancel `task` and await its completion.
+
+    Swallows the task's own CancelledError and logged-elsewhere
+    exceptions (the task already ran its error handling; reapers only
+    care that it is DONE), but re-raises when the reaping task is
+    itself being cancelled — teardown must stay cancellable."""
+    if task is None:
+        return
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        # two sources: the reaped task finishing cancelled (swallow) or
+        # our own wait being interrupted (propagate). If the reaped
+        # task is not done, the cancellation was ours.
+        if being_cancelled() or not task.done():
+            raise
+    except Exception:
+        pass
+
+
+async def reap_all(tasks: Iterable[asyncio.Task | None]) -> None:
+    """Cancel every task first (concurrent teardown), then await each."""
+    live = [t for t in tasks if t is not None]
+    for t in live:
+        t.cancel()
+    for t in live:
+        await reap(t)
+
+
+async def drain(task: asyncio.Task | None) -> None:
+    """Await `task` WITHOUT cancelling it — for work that must complete
+    (a detached close(), an in-flight commit), where cancelling would
+    leave shared state half-torn-down. Same cancellation contract as
+    reap(): the task's own failure/cancellation is swallowed, our own
+    cancellation propagates."""
+    if task is None:
+        return
+    try:
+        await task
+    except asyncio.CancelledError:
+        if being_cancelled() or not task.done():
+            raise
+    except Exception:
+        pass
+
+
+async def drain_all(tasks: Iterable[asyncio.Task | None]) -> None:
+    for t in list(tasks):
+        await drain(t)
+
+
+# -- executor-backed file I/O -------------------------------------------------
+# Sync open()/read()/write() inside a coroutine stalls the whole event
+# loop behind one syscall (radoslint: blocking-in-coroutine). The CLI
+# tools route one-shot blob I/O through the default executor instead.
+
+async def read_file(path: str) -> bytes:
+    def _read() -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+    return await asyncio.get_running_loop().run_in_executor(None, _read)
+
+
+async def write_file(path: str, data: bytes) -> None:
+    def _write() -> None:
+        with open(path, "wb") as f:
+            f.write(data)
+    await asyncio.get_running_loop().run_in_executor(None, _write)

@@ -1,0 +1,208 @@
+"""Runtime asyncio sanitizer: the dynamic half of radoslint.
+
+The static suite (ceph_tpu/tools/radoslint) proves task-lifecycle
+invariants over the AST; this module watches the same invariants on a
+LIVE event loop, the way the reference pairs lockdep (static ordering)
+with WITH_ASAN/WITH_TSAN builds (runtime). Enabled via the
+`sanitizer_enabled` config option (hot-togglable), it arms three probes
+on the daemon's loop:
+
+  * asyncio debug mode with a configurable slow-callback threshold —
+    every callback that hogs the loop longer than
+    `sanitizer_slow_callback_s` is logged through dout("san", ...) and
+    counted (`san_slow_callbacks`), so an operator sees loop stalls in
+    `perf dump` / the mgr report instead of a silent latency cliff;
+  * a task factory that records each task's CREATION stack, so a
+    leaked-task report ("Task was destroyed but it is pending!") names
+    the spawn site — without it asyncio only shows where the coroutine
+    was suspended, which for the messenger leak class is always the
+    same uninformative `await queue.get()` line;
+  * a loop exception handler that recognizes destroyed-pending-task
+    reports, increments `san_task_leaks`, and douts the recorded spawn
+    site.
+
+Counters live in the process-wide PerfCountersCollection under the
+"sanitizer" logger, so they ride the existing MgrClient report path
+(extra_loggers) to the mgr like every other metric.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import traceback
+import weakref
+
+from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.perf_counters import PerfCountersCollection
+
+DEFAULT_SLOW_CALLBACK_S = 0.1
+
+_perf = None                      # lazy: PerfCounters("sanitizer")
+#: weak so a dead loop's entry vanishes with it — an id()-keyed set
+#: would make install() a silent no-op on a new loop that happens to
+#: reuse the address
+_installed_loops: "weakref.WeakSet[asyncio.AbstractEventLoop]" = \
+    weakref.WeakSet()
+#: daemon loops that registered via maybe_install()/install(): the
+#: config observer fires on the admin-socket THREAD, which has no
+#: running loop — changes are marshalled onto these with
+#: call_soon_threadsafe
+_tracked_loops: "weakref.WeakSet[asyncio.AbstractEventLoop]" = \
+    weakref.WeakSet()
+_log_bridge = None
+
+
+def perf():
+    """The sanitizer's perf counters, created on first use."""
+    global _perf
+    if _perf is None:
+        coll = PerfCountersCollection.instance()
+        pc = coll.get("sanitizer")
+        if pc is None:
+            pc = coll.create("sanitizer")
+            pc.add("san_tasks_created",
+                   description="tasks spawned while the sanitizer was armed")
+            pc.add("san_slow_callbacks",
+                   description="callbacks exceeding the slow-callback "
+                               "threshold (event-loop stalls)")
+            pc.add("san_task_leaks",
+                   description="tasks destroyed while still pending "
+                               "(the messenger _dispatch_loop leak class)")
+        _perf = pc
+    return _perf
+
+
+def spawn_site(task: asyncio.Task) -> str | None:
+    """Creation stack recorded by the sanitizer task factory, rendered
+    as 'file:line in func' innermost-first; None when the task was
+    spawned before install() armed the factory."""
+    frames = getattr(task, "_san_spawn_stack", None)
+    if not frames:
+        return None
+    return " <- ".join(f"{f.filename}:{f.lineno} in {f.name}"
+                       for f in reversed(frames))
+
+
+def _task_factory(loop, coro, **kwargs):
+    task = asyncio.Task(coro, loop=loop, **kwargs)
+    # drop the factory/create_task frames; keep the caller's tail
+    task._san_spawn_stack = traceback.extract_stack(limit=8)[:-1]
+    perf().inc("san_tasks_created")
+    return task
+
+
+class _SlowCallbackBridge(logging.Handler):
+    """asyncio debug mode reports slow callbacks via logger.warning on
+    the 'asyncio' logger; bridge those into dout + a counter."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:
+            return
+        if "Executing" in msg and "took" in msg:
+            perf().inc("san_slow_callbacks")
+            dout("san", 1, f"slow callback: {msg}")
+
+
+def _exception_handler(loop, context: dict) -> None:
+    msg = context.get("message", "")
+    task = context.get("task")
+    if "was destroyed but it is pending" in msg and task is not None:
+        perf().inc("san_task_leaks")
+        site = spawn_site(task)
+        dout("san", 0, f"leaked task {task.get_name()}: {msg}"
+             + (f" (spawned at {site})" if site else ""))
+    loop.default_exception_handler(context)
+
+
+def install(loop: asyncio.AbstractEventLoop | None = None,
+            slow_callback_s: float = DEFAULT_SLOW_CALLBACK_S) -> None:
+    """Arm the sanitizer on `loop` (default: the running loop).
+    Idempotent per loop; counters are process-wide."""
+    global _log_bridge
+    if loop is None:
+        loop = asyncio.get_running_loop()
+    _tracked_loops.add(loop)
+    if loop in _installed_loops:
+        loop.slow_callback_duration = float(slow_callback_s)
+        return
+    loop.set_debug(True)
+    loop.slow_callback_duration = float(slow_callback_s)
+    loop.set_task_factory(_task_factory)
+    loop.set_exception_handler(_exception_handler)
+    if _log_bridge is None:
+        _log_bridge = _SlowCallbackBridge()
+        logging.getLogger("asyncio").addHandler(_log_bridge)
+    _installed_loops.add(loop)
+    perf()                              # counters exist as soon as armed
+    dout("san", 1, f"asyncio sanitizer armed (slow-callback "
+                   f"threshold {slow_callback_s}s)")
+
+
+def uninstall(loop: asyncio.AbstractEventLoop | None = None) -> None:
+    if loop is None:
+        loop = asyncio.get_running_loop()
+    if loop not in _installed_loops:
+        return
+    loop.set_debug(False)
+    loop.set_task_factory(None)
+    loop.set_exception_handler(None)
+    _installed_loops.discard(loop)
+
+
+def register_config(config) -> None:
+    """Declare the sanitizer options on `config` (idempotent) and watch
+    them — `config set sanitizer_enabled true` over the admin socket
+    arms the running loop live, matching tracer/offload hot reload."""
+    from ceph_tpu.utils.config import ConfigError, Option
+    for opt in (Option("sanitizer_enabled", "bool", False,
+                       "arm the runtime asyncio sanitizer (debug mode, "
+                       "slow-callback log, task spawn-site tracking)"),
+                Option("sanitizer_slow_callback_s", "float",
+                       DEFAULT_SLOW_CALLBACK_S,
+                       "loop-stall threshold logged by the sanitizer",
+                       minimum=0.001)):
+        try:
+            config.declare(opt)
+        except ConfigError:
+            pass                        # already declared by another daemon
+
+    def _apply(loop: asyncio.AbstractEventLoop, name: str, value) -> None:
+        if name == "sanitizer_enabled":
+            install(loop, config.get("sanitizer_slow_callback_s")) \
+                if value else uninstall(loop)
+        elif name == "sanitizer_slow_callback_s" and \
+                loop in _installed_loops:
+            loop.slow_callback_duration = float(value)
+
+    def _on_change(name: str, value) -> None:
+        try:
+            _apply(asyncio.get_running_loop(), name, value)
+        except RuntimeError:
+            # admin-socket thread: no loop here — marshal onto every
+            # daemon loop that registered (set_debug/set_task_factory
+            # must run on the loop's own thread)
+            for loop in list(_tracked_loops):
+                if not loop.is_closed():
+                    loop.call_soon_threadsafe(_apply, loop, name, value)
+
+    config.add_observer(("sanitizer_enabled", "sanitizer_slow_callback_s"),
+                        _on_change)
+
+
+def maybe_install(config=None) -> None:
+    """Arm the sanitizer on the running loop when enabled. Daemons call
+    this from start(); with no config (mds/rgw/client tools) it is a
+    no-op unless another daemon in the process already armed the loop."""
+    if config is None:
+        return
+    try:
+        # track this daemon's loop even while disabled, so a later
+        # `config set sanitizer_enabled true` from the admin-socket
+        # thread knows which loop(s) to arm
+        _tracked_loops.add(asyncio.get_running_loop())
+        if config.get("sanitizer_enabled"):
+            install(slow_callback_s=config.get("sanitizer_slow_callback_s"))
+    except Exception:
+        pass                            # options not declared on this config

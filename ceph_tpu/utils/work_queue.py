@@ -27,6 +27,7 @@ import contextvars
 import time
 from typing import Awaitable, Callable
 
+from ceph_tpu.utils.async_util import being_cancelled
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.throttle import HeartbeatMap
 
@@ -239,11 +240,16 @@ class ShardedOpQueue:
         self._stopping = True
         for ev in self._wake:
             ev.set()
+        # workers exit via the wake events, not cancellation. Unlike
+        # drain(), an unexpected worker crash must PROPAGATE out of
+        # stop() — swallowing it would report clean shutdown over a
+        # dead shard; only our own cancellation contract applies
         for t in self._tasks:
             try:
                 await t
             except asyncio.CancelledError:
-                pass
+                if being_cancelled() or not t.done():
+                    raise       # a cancelled stop() stays cancellable
         self._tasks.clear()
         for hid in self._hb_ids:
             self._hb_map.remove_worker(hid)

@@ -1,0 +1,14 @@
+"""Known-negative: locks and awaits that never overlap wrongly."""
+import asyncio
+
+
+async def fine(state):
+    async with state.alock:          # asyncio.Lock via async with
+        await asyncio.sleep(0)
+    with state.lock:
+        state.count += 1             # no await under the sync lock
+
+
+def sync_path(state):
+    with state.lock:
+        state.count += 1

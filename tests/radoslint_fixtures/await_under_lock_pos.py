@@ -1,0 +1,7 @@
+"""Known-positive: await while holding a sync threading lock."""
+import asyncio
+
+
+async def deadlock_bait(state):
+    with state.lock:                 # sync lock held across a suspension
+        await asyncio.sleep(0)       # finding anchors on the with-stmt

@@ -1,0 +1,13 @@
+"""Known-negative: blocking work stays out of coroutine bodies."""
+import asyncio
+import time
+
+
+def sync_path():
+    time.sleep(0.01)                 # sync function: allowed
+
+
+async def polite(loop, path):
+    await asyncio.sleep(0.01)
+    # the blocking open() lives in a lambda run on an executor thread
+    return await loop.run_in_executor(None, lambda: open(path).read())

@@ -1,0 +1,11 @@
+"""Known-positive: sync blocking calls inside coroutines."""
+import subprocess
+import time
+
+
+async def stall_the_loop(pool, job):
+    time.sleep(1)                        # finding: blocks the loop
+    subprocess.run(["true"])             # finding: sync subprocess
+    data = open("/tmp/fixture").read()   # finding: sync file I/O
+    res = pool.submit(job).result()      # finding: sync executor wait
+    return data, res

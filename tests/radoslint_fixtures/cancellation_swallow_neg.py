@@ -1,0 +1,25 @@
+"""Known-negative: cancellation-correct exception handling."""
+import asyncio
+
+
+async def plain_exception_is_fine(q):
+    try:
+        await q.get()
+    except Exception:                # CancelledError sails past this
+        pass
+
+
+async def reraises(q):
+    try:
+        await q.get()
+    except asyncio.CancelledError:
+        raise                        # teardown stays cancellable
+    except Exception:
+        pass
+
+
+def sync_catch_all(fn):
+    try:
+        return fn()
+    except BaseException:            # sync scope: no cancellation flow
+        return None

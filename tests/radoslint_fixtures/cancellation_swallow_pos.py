@@ -1,0 +1,15 @@
+"""Known-positive: coroutines that eat their own cancellation."""
+import asyncio
+import contextlib
+
+
+async def eats_cancel(q):
+    try:
+        await q.get()
+    except BaseException:            # finding: swallows CancelledError
+        pass
+
+
+async def suppresses(q):
+    with contextlib.suppress(asyncio.CancelledError):   # finding
+        await q.get()

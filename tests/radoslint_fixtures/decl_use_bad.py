@@ -1,0 +1,16 @@
+"""Known-positive decl-use: dead knob, ghost counter, undeclared read,
+leaked span handle."""
+
+
+def declare(config, perf, Option):
+    config.declare(Option("dead_knob_xyz", "bool", False, "never read"))
+    perf.add("ghost_counter", description="never incremented")
+
+
+def use(config):
+    return config.get("undeclared_knob_abc")    # read, never declared
+
+
+def leak(tracer):
+    sp = tracer.start_span("orphan_span")       # never finish()ed
+    return 1

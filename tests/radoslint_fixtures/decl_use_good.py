@@ -1,0 +1,16 @@
+"""Known-negative decl-use: every declaration has a live use."""
+
+
+def declare(config, perf, Option):
+    config.declare(Option("live_knob", "bool", False, "read below"))
+    perf.add("live_counter", description="incremented below")
+
+
+def use(config, perf):
+    if config.get("live_knob"):
+        perf.inc("live_counter")
+
+
+def spans(tracer):
+    sp = tracer.start_span("balanced_span")
+    sp.finish()

@@ -1,0 +1,17 @@
+"""Known-negative: every spawned task is stored, awaited, or owned."""
+import asyncio
+
+
+async def work():
+    await asyncio.sleep(0)
+
+
+async def spawn_tracked(reap_set):
+    t = asyncio.create_task(work())             # stored
+    reap_set.add(asyncio.create_task(work()))   # registered with a reap set
+    await t
+
+
+async def spawn_grouped():
+    async with asyncio.TaskGroup() as tg:
+        tg.create_task(work())                  # group owns the lifecycle

@@ -1,0 +1,11 @@
+"""Known-positive: spawned task handles dropped on the floor."""
+import asyncio
+
+
+async def work():
+    await asyncio.sleep(0)
+
+
+async def spawn_and_forget():
+    asyncio.create_task(work())      # handle discarded: finding
+    asyncio.ensure_future(work())    # handle discarded: finding

@@ -1,0 +1,7 @@
+"""Known-positive frame tags: a value collision."""
+
+
+class Tag:
+    HELLO = 1
+    AUTH = 1          # collides with HELLO
+    MESSAGE = 2

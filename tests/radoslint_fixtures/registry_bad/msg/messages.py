@@ -1,0 +1,18 @@
+"""Known-positive registry: collisions, mislabels, dead wire protocol."""
+
+
+def _simple(type_id, name):
+    return (type_id, name)
+
+
+class Message:
+    pass
+
+
+MPing = _simple(0x01, "MPing")
+MEcho = _simple(0x01, "MEcho")            # type-id collision with MPing
+MMislabeled = _simple(0x02, "MOther")     # bound name != registered name
+
+
+class MOrphan(Message):
+    TYPE = 0x03                            # never register_message'd

@@ -1,0 +1,7 @@
+"""Handler side: every registry_good message is named here, so the
+never-sent-or-handled check sees a reference outside the declaration."""
+
+HANDLERS = {
+    "MPing": lambda m: m,
+    "MStatus": lambda m: m,
+}

@@ -1,0 +1,7 @@
+"""Known-negative frame tags: collision-free."""
+
+
+class Tag:
+    HELLO = 1
+    AUTH = 2
+    MESSAGE = 3

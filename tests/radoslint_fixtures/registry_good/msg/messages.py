@@ -1,0 +1,21 @@
+"""Known-negative registry: unique ids, registered, referenced."""
+
+
+def _simple(type_id, name):
+    return (type_id, name)
+
+
+def register_message(cls):
+    return cls
+
+
+class Message:
+    pass
+
+
+MPing = _simple(0x01, "MPing")
+
+
+@register_message
+class MStatus(Message):
+    TYPE = 0x02
