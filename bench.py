@@ -39,6 +39,7 @@ TOTAL_BUDGET = int(os.environ.get("CEPH_TPU_BENCH_TIMEOUT", "2400"))
 CPU_TIMEOUT = 420
 DEVICE_TIMEOUT = 900  # single long warm: backend init + benches, one child
 CLUSTER_TPU_TIMEOUT = 420  # in-situ EC-over-tpu cluster stage
+ATTRIBUTION_TIMEOUT = 240  # hermetic attribution-profiler stage
 METRIC = "ec_encode_k8m3_1MiB_chunk"
 
 _deadline = time.monotonic() + TOTAL_BUDGET
@@ -150,6 +151,15 @@ def main() -> int:
         if fallback.get("status") == "ok":
             cluster_tpu = fallback
 
+    # Stage 4: data-path attribution — the "where the 450x goes"
+    # waterfall (queue-wait/copy/H2D/kernel/D2H/commit from real spans,
+    # copy amplification, loop busy fraction, per-device utilization).
+    # Hermetic: it profiles the FRAMEWORK's data path, and the loop/
+    # copy numbers must not hinge on tunnel health.
+    attribution = run_stage("attribution", _hermetic_env(),
+                            _budget(ATTRIBUTION_TIMEOUT))
+    stages["attribution"] = attribution
+
     detail = {k: v for k, v in cpu.items()
               if k not in ("status", "elapsed_s", "stderr_tail")}
     detail.update({k: v for k, v in cluster.items()
@@ -157,6 +167,9 @@ def main() -> int:
     detail.update({k: v for k, v in cluster_tpu.items()
                    if k not in ("status", "elapsed_s", "stderr_tail",
                                 "offload_status")})
+    detail.update({k: v for k, v in attribution.items()
+                   if k not in ("status", "elapsed_s", "stderr_tail",
+                                "attribution")})
     detail.update({k: v for k, v in device.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})
 
@@ -176,6 +189,10 @@ def main() -> int:
         # cluster observability snapshot (status, check codes,
         # per-daemon report ages) from the cluster stage's health probe
         "health": detail.pop("health", None),
+        # the attribution waterfall: queue-wait/copy/H2D/kernel/D2H/
+        # commit buckets from real spans, copy amplification, loop
+        # busy fraction, per-device utilization
+        "attribution": attribution.get("attribution"),
         "baseline": baseline_name,
         "platform": device.get("platform", "none"),
         "detail": detail,

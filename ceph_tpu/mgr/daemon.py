@@ -49,8 +49,8 @@ class DaemonState:
     """One reporting daemon's aggregated state (src/mgr/DaemonState.h)."""
 
     __slots__ = ("name", "service", "schema", "counters", "status",
-                 "health_metrics", "progress", "last_report_mono",
-                 "reports")
+                 "health_metrics", "progress", "device_metrics",
+                 "last_report_mono", "reports")
 
     def __init__(self, name: str, service: str):
         self.name = name
@@ -60,6 +60,7 @@ class DaemonState:
         self.status: dict = {}
         self.health_metrics: dict = {}
         self.progress: list = []
+        self.device_metrics: dict = {}
         self.last_report_mono = time.monotonic()
         self.reports = 0
 
@@ -105,6 +106,8 @@ class DaemonStateIndex:
         st.status = payload.get("daemon_status") or {}
         st.health_metrics = payload.get("health_metrics") or {}
         st.progress = payload.get("progress") or []
+        dm = payload.get("device_metrics")
+        st.device_metrics = dm if isinstance(dm, dict) else {}
         st.last_report_mono = time.monotonic()
         st.reports += 1
         return st
@@ -121,6 +124,13 @@ class DaemonStateIndex:
         """(daemon, schema, counters) triples for the exporter."""
         return [(name, st.schema, st.counters)
                 for name, st in sorted(self.daemons.items())]
+
+    def device_sources(self) -> list[tuple[str, dict]]:
+        """(daemon, {device: {counter: value}}) pairs for the exporter's
+        ceph_device-labeled families."""
+        return [(name, st.device_metrics)
+                for name, st in sorted(self.daemons.items())
+                if st.device_metrics]
 
     def report_ages(self) -> dict[str, float]:
         return {name: round(st.age, 3)

@@ -102,6 +102,29 @@ def render_metrics(health: dict | None = None, index=None) -> str:
                                       {"type": ftype, "rows": []})
             fam["rows"].extend(rows)
     if from_reports:
+        # per-device labeled counters (offload utilization): one family
+        # per counter name, rows labeled by daemon AND device, so the
+        # mesh fan-out's balance is graphable per accelerator
+        # shapes arrive in remote MMgrReport payloads: like the daemon
+        # names above, one malformed report must not break the scrape
+        for daemon, devmap in index.device_sources():
+            dlabel = _label_escape(daemon)
+            if not isinstance(devmap, dict):
+                continue
+            for device, counters in sorted(devmap.items()):
+                if not isinstance(counters, dict):
+                    continue
+                vlabel = _label_escape(str(device))
+                for key, value in sorted(counters.items()):
+                    if not isinstance(value, (int, float)) or \
+                            isinstance(value, bool):
+                        continue
+                    metric = f"ceph_{_sanitize(key)}"
+                    fam = families.setdefault(
+                        metric, {"type": "counter", "rows": []})
+                    fam["rows"].append(
+                        f'{metric}{{ceph_daemon="{dlabel}",'
+                        f'ceph_device="{vlabel}"}} {value}')
         fam = families.setdefault("ceph_daemon_report_age_seconds",
                                   {"type": "gauge", "rows": []})
         for daemon, age in index.report_ages().items():

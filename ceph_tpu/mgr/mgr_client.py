@@ -44,6 +44,7 @@ class MgrClient(Dispatcher):
                  status_cb: Callable[[], dict] | None = None,
                  health_cb: Callable[[], dict] | None = None,
                  progress_cb: Callable[[], list] | None = None,
+                 device_cb: Callable[[], dict] | None = None,
                  perf_name: str | None = None,
                  extra_loggers: tuple[str, ...] = ()):
         self.messenger = messenger
@@ -54,6 +55,10 @@ class MgrClient(Dispatcher):
         self.status_cb = status_cb
         self.health_cb = health_cb
         self.progress_cb = progress_cb
+        # per-device labeled metrics (e.g. the offload service's
+        # per-accelerator utilization): {device: {counter: value}},
+        # exported with a `ceph_device` label alongside `ceph_daemon`
+        self.device_cb = device_cb
         self.perf_name = perf_name or daemon_name
         # process-shared perf loggers this daemon also reports (e.g. the
         # EC offload service's "offload" counters), merged into the
@@ -165,6 +170,7 @@ class MgrClient(Dispatcher):
         payload["daemon_status"] = self._safe(self.status_cb, {})
         payload["health_metrics"] = self._safe(self.health_cb, {})
         payload["progress"] = self._safe(self.progress_cb, [])
+        payload["device_metrics"] = self._safe(self.device_cb, {})
         conn.send_message(MMgrReport(payload))
         self.reports_sent += 1
         return True

@@ -47,12 +47,13 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import contextvars
+import threading
 import time
 from typing import Any, Callable
 
 import numpy as np
 
-from ceph_tpu.utils import tracer
+from ceph_tpu.utils import copytrack, tracer
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import (TYPE_GAUGE, TYPE_HISTOGRAM,
                                           PerfCountersCollection)
@@ -173,6 +174,17 @@ class OffloadService:
         self.stats = {"jobs": 0, "batches": 0, "coalesced_ops": 0,
                       "fallback_ops": 0, "breaker_trips": 0,
                       "batched_ops": 0}
+        # per-device utilization: busy wall time / bytes / batches per
+        # dispatch target. Today every device batch lands on one
+        # accelerator; fallback and host-native batches are attributed
+        # to "host". The mesh fan-out grades its balance against these.
+        self.device_stats: dict[str, dict] = {}
+        # guards device_stats against admin-socket-thread readers
+        # (`ec offload status` / the MgrClient device_cb) racing the
+        # loop's first-seen-device key inserts: unlike self.stats, the
+        # key set grows at runtime
+        self._dev_lock = threading.Lock()
+        self._dev_label: str | None = None
         # circuit breaker
         self.degraded = False
         self._degraded_since = 0.0
@@ -311,21 +323,32 @@ class OffloadService:
         baseline the bench's inline comparison measures."""
         self.perf.inc("jobs")
         self.stats["jobs"] += 1
+        nbytes = int(data.nbytes)
         if not uses_device:
+            t0 = time.perf_counter()
             out = dispatch(data)
-            self._note_batch(1, int(data.nbytes))
+            self._note_device("host", 1, nbytes,
+                              time.perf_counter() - t0)
+            self._note_batch(1, nbytes)
             return out
         if self._device_allowed():
             try:
+                t0 = time.perf_counter()
                 out = dispatch(data)
                 self._device_success()
-                self._note_batch(1, int(data.nbytes))
+                self._note_device(self._device_label(), 1, nbytes,
+                                  time.perf_counter() - t0)
+                self._note_batch(1, nbytes)
                 return out
             except Exception as e:
                 self._device_failure(e)
         self.perf.inc("fallback_ops")
         self.stats["fallback_ops"] += 1
-        return fallback(data)
+        t0 = time.perf_counter()
+        out = fallback(data)
+        self._note_device("host", 1, nbytes,
+                          time.perf_counter() - t0, fallback=True)
+        return out
 
     async def _acquire(self, nbytes: int) -> None:
         if 0 < self._throttle.max <= nbytes:
@@ -462,9 +485,21 @@ class OffloadService:
                         if j.span is not None:
                             j.span.set_tag("batch_ops", len(jobs))
                             j.span.finish()
+                    # a lone job's array is handed to the device as-is
+                    # (referenced); coalesced jobs pay one stacking copy
+                    # — the bufferlist->staging leg of the copy ledger
+                    t_stack = time.perf_counter()
                     stacked = jobs[0].data if len(jobs) == 1 else \
                         np.concatenate([j.data for j in jobs], axis=0)
+                    stack_s = time.perf_counter() - t_stack
                     nbytes = int(stacked.nbytes)
+                    if len(jobs) == 1:
+                        copytrack.referenced("buffer_to_staging", nbytes)
+                        stack_us = 0.0
+                    else:
+                        copytrack.copied("buffer_to_staging", nbytes,
+                                         stack_s)
+                        stack_us = round(stack_s * 1e6, 1)
                     with tracer.span("offload_batch") as sp:
                         out, on_device = await self._dispatch(
                             bucket, stacked, len(jobs))
@@ -472,6 +507,9 @@ class OffloadService:
                             sp.set_tag("ops", len(jobs))
                             sp.set_tag("bytes", nbytes)
                             sp.set_tag("device", on_device)
+                            sp.set_tag("copy_bytes",
+                                       nbytes if len(jobs) > 1 else 0)
+                            sp.set_tag("copy_us", stack_us)
                     self._note_batch(len(jobs), nbytes)
                     row = 0
                     for j in jobs:
@@ -505,20 +543,74 @@ class OffloadService:
     async def _dispatch(self, bucket: _Bucket, stacked: np.ndarray,
                         n_ops: int) -> tuple[np.ndarray, bool]:
         """One staged device dispatch with host-codec failover."""
+        nbytes = int(stacked.nbytes)
         if not bucket.uses_device:
+            t0 = time.perf_counter()
             out = await self._in_staging_pool(bucket.dispatch, stacked)
+            self._note_device("host", n_ops, nbytes,
+                              time.perf_counter() - t0)
             return out, False
         if self._device_allowed():
             try:
+                t0 = time.perf_counter()
                 out = await self._in_staging_pool(bucket.dispatch, stacked)
                 self._device_success()
+                self._note_device(self._device_label(), n_ops, nbytes,
+                                  time.perf_counter() - t0)
                 return out, True
             except Exception as e:
                 self._device_failure(e)
         self.perf.inc("fallback_ops", n_ops)
         self.stats["fallback_ops"] += n_ops
+        t0 = time.perf_counter()
         out = await self._in_staging_pool(bucket.fallback, stacked)
+        self._note_device("host", n_ops, nbytes,
+                          time.perf_counter() - t0, fallback=True)
         return out, False
+
+    def _device_label(self) -> str:
+        """Identity of the accelerator device batches land on (the
+        `ceph_device` metric label). Resolved once; host fallback and
+        host-native batches use the fixed "host" label instead."""
+        if self._dev_label is None:
+            try:
+                import jax
+                d = jax.devices()[0]
+                self._dev_label = f"{d.platform}:{d.id}"
+            except Exception:
+                self._dev_label = "device:0"
+        return self._dev_label
+
+    def _note_device(self, device: str, n_ops: int, nbytes: int,
+                     busy_s: float, fallback: bool = False) -> None:
+        with self._dev_lock:
+            d = self.device_stats.get(device)
+            if d is None:
+                d = self.device_stats[device] = {
+                    "batches": 0, "ops": 0, "bytes": 0, "busy_s": 0.0,
+                    "fallback_ops": 0}
+            d["batches"] += 1
+            d["ops"] += n_ops
+            d["bytes"] += nbytes
+            d["busy_s"] += busy_s
+            if fallback:
+                d["fallback_ops"] += n_ops
+
+    def device_snapshot(self) -> dict[str, dict]:
+        """Consistent copy of device_stats, safe off the loop thread."""
+        with self._dev_lock:
+            return {dev: dict(d) for dev, d in self.device_stats.items()}
+
+    def device_metrics(self) -> dict:
+        """Per-device counters for the MgrClient report path: the mgr
+        stores them per daemon and the exporter renders each as a
+        `ceph_device`-labeled family."""
+        return {dev: {"offload_device_busy_seconds": round(d["busy_s"], 6),
+                      "offload_device_bytes": d["bytes"],
+                      "offload_device_batches": d["batches"],
+                      "offload_device_ops": d["ops"],
+                      "offload_device_fallback_ops": d["fallback_ops"]}
+                for dev, d in self.device_snapshot().items()}
 
     def _note_batch(self, n_ops: int, nbytes: int) -> None:
         self.perf.inc("batches")
@@ -606,6 +698,8 @@ class OffloadService:
             "breaker_trips": s["breaker_trips"],
             "mean_batch_ops": round(s["batched_ops"] / s["batches"], 3)
             if s["batches"] else 0.0,
+            "devices": {dev: dict(d, busy_s=round(d["busy_s"], 6))
+                        for dev, d in self.device_snapshot().items()},
         }
 
 

@@ -33,7 +33,7 @@ from ceph_tpu.objectstore.memstore import MemStore
 from ceph_tpu.objectstore.store import StoreError
 from ceph_tpu.osd.backend import IntervalChange
 from ceph_tpu.osd.pg import PGInstance
-from ceph_tpu.utils import sanitizer, tracer
+from ceph_tpu.utils import copytrack, loopprof, sanitizer, tracer
 from ceph_tpu.utils.admin_socket import AdminSocket
 from ceph_tpu.utils.async_util import reap_all
 from ceph_tpu.utils.config import Config, Option
@@ -101,6 +101,14 @@ class OSD(Dispatcher):
         # task spawn-site tracking): `config set sanitizer_enabled
         # true` arms the running loop live
         sanitizer.register_config(self.config)
+        # event-loop sampling profiler (`profile dump` over the admin
+        # socket): loop-busy-fraction + top stall sites, hot-togglable
+        # via `config set profiler_enabled true`
+        loopprof.register_config(self.config)
+        # the profiler/copy-ledger counter mirrors must exist before the
+        # first MgrClient report so their families export from round one
+        loopprof.perf()
+        copytrack.perf()
         # per-daemon perf counters, served by `perf dump` (the admin
         # socket reads the process-wide collection)
         coll = PerfCountersCollection.instance()
@@ -186,7 +194,9 @@ class OSD(Dispatcher):
             status_cb=self._daemon_status,
             health_cb=self._mgr_health_metrics,
             progress_cb=self._mgr_progress,
-            extra_loggers=("offload", "sanitizer"))
+            device_cb=self._mgr_device_metrics,
+            extra_loggers=("offload", "sanitizer", "loopprof",
+                           "copyflow"))
         # the per-loop offload service handle (set at start(): the
         # admin-socket thread cannot resolve the running loop itself)
         self._offload_svc = None
@@ -236,6 +246,7 @@ class OSD(Dispatcher):
         from ceph_tpu import offload
         self._offload_svc = offload.get_service()
         sanitizer.maybe_install(self.config)
+        loopprof.maybe_install(self.config)
         self.op_queue.start()
         self.finisher.start()
         if self.asok is not None:
@@ -299,6 +310,13 @@ class OSD(Dispatcher):
                 "offload": (self._offload_svc.health_metrics()
                             if self._offload_svc is not None else {}),
                 "store": self.store.statfs()}
+
+    def _mgr_device_metrics(self) -> dict:
+        """Per-device offload utilization for the report path: the mgr
+        stores these per daemon; the exporter renders them with a
+        `ceph_device` label."""
+        return (self._offload_svc.device_metrics()
+                if self._offload_svc is not None else {})
 
     def _offload_admin(self, cmd: str) -> dict:
         if self._offload_svc is None:

@@ -20,12 +20,13 @@ checked on reads/deep-scrub.
 """
 from __future__ import annotations
 
+import time
 from typing import Iterable, Mapping
 
 import numpy as np
 
 from ceph_tpu.ec.interface import ErasureCodeError
-from ceph_tpu.utils import tracer
+from ceph_tpu.utils import copytrack, tracer
 
 
 class StripeInfo:
@@ -108,9 +109,18 @@ class StripeInfo:
 def _encode_frame(sinfo: StripeInfo, ec_impl, data, want):
     """Shared validation/framing for encode(): returns
     (stripes (S,k,C) | None, want set, k, n_chunks, mapping, batched)."""
-    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(
-        data, (bytes, bytearray, memoryview)) else np.ascontiguousarray(
-        data, dtype=np.uint8).reshape(-1)
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        # np.frombuffer windows the message bytes — no copy
+        buf = np.frombuffer(data, dtype=np.uint8)
+        copytrack.referenced("frame_to_buffer", buf.size)
+    else:
+        t0 = time.perf_counter()
+        buf = np.ascontiguousarray(data, dtype=np.uint8).reshape(-1)
+        if np.shares_memory(buf, data):
+            copytrack.referenced("frame_to_buffer", buf.size)
+        else:
+            copytrack.copied("frame_to_buffer", buf.size,
+                             time.perf_counter() - t0)
     if buf.size % sinfo.stripe_width:
         raise ErasureCodeError(
             f"input size {buf.size} not a multiple of stripe width "
@@ -133,14 +143,24 @@ def _encode_frame(sinfo: StripeInfo, ec_impl, data, want):
 
 
 def _encode_assemble(stripes: np.ndarray, parity: np.ndarray, k: int,
-                     want) -> dict[int, bytes]:
+                     want, sp=None) -> dict[int, bytes]:
     # shard-major contiguous copies first: .tobytes() on a strided
     # view falls off numpy's memcpy path (~30x slower — profiled on
     # the OSD write path)
+    t0 = time.perf_counter()
     dm = np.ascontiguousarray(stripes.transpose(1, 0, 2))      # (k,S,C)
     pm = np.ascontiguousarray(parity.transpose(1, 0, 2))       # (m,S,C)
-    return {i: (dm[i] if i < k else pm[i - k]).tobytes()
-            for i in sorted(want)}
+    out = {i: (dm[i] if i < k else pm[i - k]).tobytes()
+           for i in sorted(want)}
+    # two real copies per shard byte: the transpose materialization and
+    # the per-shard tobytes() — the D2H->reply half of the copy ledger
+    nbytes = dm.nbytes + pm.nbytes + sum(len(b) for b in out.values())
+    dt = time.perf_counter() - t0
+    copytrack.copied("reply_assemble", nbytes, dt)
+    if sp is not None:
+        sp.set_tag("copy_bytes", nbytes)
+        sp.set_tag("copy_us", round(dt * 1e6, 1))
+    return out
 
 
 def _encode_scalar(sinfo: StripeInfo, ec_impl, stripes, want, k, n_chunks,
@@ -172,7 +192,7 @@ def _encode_framed(sinfo: StripeInfo, ec_impl, stripes, want, k, n_chunks,
             sp.set_tag("batched", batched)
         if batched:
             parity = np.asarray(ec_impl.encode_stripes(stripes))
-            return _encode_assemble(stripes, parity, k, want)
+            return _encode_assemble(stripes, parity, k, want, sp=sp)
         return _encode_scalar(sinfo, ec_impl, stripes, want, k, n_chunks,
                               mapping)
 
@@ -219,7 +239,7 @@ async def encode_async(sinfo: StripeInfo, ec_impl,
             sp.set_tag("batched", True)
             sp.set_tag("offload", True)
         parity = np.asarray(await service.encode(ec_impl, stripes))
-        return _encode_assemble(stripes, parity, k, want)
+        return _encode_assemble(stripes, parity, k, want, sp=sp)
 
 
 def _reconstruct_stack(ec_impl, stacked: Mapping[int, np.ndarray],

@@ -1,0 +1,64 @@
+"""Ephemeral mini-cluster boot/teardown shared by the bench stages and
+CLI drivers.
+
+Three call sites used to hand-roll the same sequence — ephemeral port,
+tmpdir, MonMap/Monitor boot, leader wait, OSD loop, client connect,
+and the reaping teardown — and the BENCH_r05 "Task was destroyed but
+it is pending" fix had to be applied to each copy separately. This is
+the one copy: teardown always runs (even when an OSD fails to start
+mid-loop), always through `bounded_stop`, so a wedged daemon stop is
+cancelled-and-awaited rather than abandoned. Pool/profile creation
+stays with the caller — that is what the call sites actually differ in.
+"""
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import socket
+import tempfile
+from typing import AsyncIterator, Callable
+
+from ceph_tpu.utils.async_util import bounded_stop
+
+
+@contextlib.asynccontextmanager
+async def ephemeral_cluster(
+        n_osds: int, prefix: str = "ceph-tpu-",
+        store_factory: Callable[[str, int], object] | None = None,
+        stop_timeout: float = 20.0) -> AsyncIterator[tuple]:
+    """Boot mon + `n_osds` OSDs on localhost and a connected client;
+    yield `(client, osds, mon)`; reap everything on exit.
+
+    `store_factory(tmpdir, osd_id)` supplies a per-OSD ObjectStore
+    (None -> MemStore default)."""
+    from ceph_tpu.mon import MonMap, Monitor
+    from ceph_tpu.osd.daemon import OSD
+    from ceph_tpu.rados import RadosClient
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    tmp = tempfile.mkdtemp(prefix=prefix)
+    monmap = MonMap({"m0": ("127.0.0.1", port)})
+    mon = Monitor("m0", monmap, store_path=f"{tmp}/mon")
+    await mon.start()
+    osds: list = []
+    client = None
+    try:
+        while not (mon.paxos.is_leader() and mon.paxos.is_active()):
+            await asyncio.sleep(0.05)
+        for i in range(n_osds):
+            store = store_factory(tmp, i) if store_factory else None
+            osd = OSD(i, list(monmap.mons.values()), store=store)
+            await osd.start()
+            osds.append(osd)
+        client = RadosClient(list(monmap.mons.values()))
+        await client.connect()
+        yield client, osds, mon
+    finally:
+        if client is not None:
+            await bounded_stop(client.shutdown(), stop_timeout)
+        for osd in osds:
+            await bounded_stop(osd.stop(), stop_timeout)
+        await bounded_stop(mon.stop(), stop_timeout)

@@ -82,59 +82,38 @@ async def run_bench(io, seconds: float = 5.0, concurrency: int = 8,
 
 
 async def _main(args) -> dict:
-    from ceph_tpu.mon import MonMap, Monitor
-    from ceph_tpu.osd.daemon import OSD
-    from ceph_tpu.rados import RadosClient
-    import socket
+    # boot/teardown via the shared helper: the timeout-bounded REAPING
+    # stop (not abandoning — the "Task was destroyed but it is pending"
+    # BENCH_r05 tail spam came from exactly this path bailing out
+    # mid-shutdown) lives in cluster_boot.ephemeral_cluster now
+    from ceph_tpu.tools.cluster_boot import ephemeral_cluster
 
-    def free_ports(n):
-        socks, ports = [], []
-        for _ in range(n):
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            socks.append(s)
-            ports.append(s.getsockname()[1])
-        for s in socks:
-            s.close()
-        return ports
-
-    import tempfile
-    tmp = tempfile.mkdtemp(prefix="rados-bench-")
-    monmap = MonMap({"m0": ("127.0.0.1", free_ports(1)[0])})
-    mon = Monitor("m0", monmap, store_path=f"{tmp}/mon")
-    await mon.start()
-    while not (mon.paxos.is_leader() and mon.paxos.is_active()):
-        await asyncio.sleep(0.05)
-    osds = []
-    for i in range(args.osds):
-        store = None
+    def store_factory(tmp, i):
         if args.backend == "filestore":
             from ceph_tpu.objectstore import FileStore
-            store = FileStore(f"{tmp}/osd{i}")
-        osd = OSD(i, list(monmap.mons.values()), store=store)
-        await osd.start()
-        osds.append(osd)
-    client = RadosClient(list(monmap.mons.values()))
-    await client.connect()
-    if args.pool_type == "erasure":
-        await client.command({
-            "prefix": "osd erasure-code-profile set", "name": "benchprof",
-            "profile": {"plugin": args.plugin, "k": str(args.k),
-                        "m": str(args.m)}})
-        await client.pool_create("bench", pg_num=8, pool_type="erasure",
-                                 erasure_code_profile="benchprof")
-    else:
-        await client.pool_create("bench", pg_num=8, size=args.osds)
-    io = client.ioctx("bench")
-    out = await run_bench(io, seconds=args.seconds,
-                          concurrency=args.concurrency,
-                          object_size=args.object_size)
-    out["pool_type"] = args.pool_type
-    await client.shutdown()
-    for osd in osds:
-        await osd.stop()
-    await mon.stop()
-    return out
+            return FileStore(f"{tmp}/osd{i}")
+        return None
+
+    async with ephemeral_cluster(args.osds, prefix="rados-bench-",
+                                 store_factory=store_factory) \
+            as (client, _osds, _mon):
+        if args.pool_type == "erasure":
+            await client.command({
+                "prefix": "osd erasure-code-profile set",
+                "name": "benchprof",
+                "profile": {"plugin": args.plugin, "k": str(args.k),
+                            "m": str(args.m)}})
+            await client.pool_create("bench", pg_num=8,
+                                     pool_type="erasure",
+                                     erasure_code_profile="benchprof")
+        else:
+            await client.pool_create("bench", pg_num=8, size=args.osds)
+        io = client.ioctx("bench")
+        out = await run_bench(io, seconds=args.seconds,
+                              concurrency=args.concurrency,
+                              object_size=args.object_size)
+        out["pool_type"] = args.pool_type
+        return out
 
 
 def main() -> None:

@@ -153,6 +153,28 @@ def _collect_decl_use(files: list[SourceFile]):
     prefix_consts: set[str] = set()
 
     for sf in files:
+        # PerfCounters subclasses (the pull-model logger mirrors, e.g.
+        # copytrack/loopprof): `self.add("x")` declares a counter and
+        # `self.set("x", v)` / `self.inc("x")` uses it, even though the
+        # receiver is `self` rather than a *perf*-named handle
+        for cls in ast.walk(sf.tree):
+            if not (isinstance(cls, ast.ClassDef)
+                    and any("PerfCounters" in (terminal_name(b) or "")
+                            for b in cls.bases)):
+                continue
+            for node in ast.walk(cls):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and dotted(node.func.value) == "self"
+                        and node.args):
+                    continue
+                name = _const_str(node.args[0])
+                if name is None:
+                    continue
+                if node.func.attr == "add" and name not in perf_decls:
+                    perf_decls[name] = (sf.path, node.args[0].lineno)
+                elif node.func.attr in _PERF_METHODS | {"set"}:
+                    perf_used.add(name)
         for node in ast.walk(sf.tree):
             if isinstance(node, ast.Constant) and \
                     isinstance(node.value, str):
@@ -298,4 +320,70 @@ def check_decl_use(files: list[SourceFile]) -> list[Finding]:
             f"incremented/set — it graphs as forever-zero"))
     for sf in files:
         out.extend(_span_leaks(sf))
+    return out
+
+
+# -- report-export-consistency ------------------------------------------------
+
+def _logger_decls(files: list[SourceFile]) -> dict[str, tuple[str, int]]:
+    """Every perf-logger NAME the process-wide collection can hold:
+    `coll.create("x")`, `PerfCounters("x")`, and `super().__init__("x")`
+    inside a PerfCounters subclass (the pull-model mirrors). Dynamic
+    names (f-strings like f"osd.{whoami}") are invisible here — fine,
+    extra_loggers entries are literal process-wide logger names."""
+    decls: dict[str, tuple[str, int]] = {}
+
+    def note(node: ast.Call) -> None:
+        name = _const_str(node.args[0]) if node.args else None
+        if name is not None and name not in decls:
+            decls[name] = (sf.path, node.lineno)
+
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if terminal_name(fn) == "PerfCounters":
+                note(node)
+            elif isinstance(fn, ast.Attribute) and fn.attr == "create" \
+                    and "coll" in (dotted(fn.value) or "").lower():
+                note(node)
+            elif isinstance(fn, ast.Attribute) and \
+                    fn.attr == "__init__" and \
+                    isinstance(fn.value, ast.Call) and \
+                    terminal_name(fn.value.func) == "super":
+                note(node)
+    return decls
+
+
+@rule("report-export-consistency", "project",
+      "every logger name in an MgrClient `extra_loggers=` tuple must "
+      "match a PerfCounters logger declared somewhere in the tree: the "
+      "report path looks the name up in the process-wide collection "
+      "and SILENTLY skips a miss, so a typo'd or renamed logger's "
+      "counters never reach the mgr aggregation or the /metrics "
+      "exporter family list — the dashboard just loses the family.")
+def check_report_export(files: list[SourceFile]) -> list[Finding]:
+    decls = _logger_decls(files)
+    out: list[Finding] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "extra_loggers" or \
+                        not isinstance(kw.value, (ast.Tuple, ast.List)):
+                    continue
+                for el in kw.value.elts:
+                    name = _const_str(el)
+                    if name is not None and name not in decls:
+                        out.append(Finding(
+                            sf.path, el.lineno,
+                            "report-export-consistency",
+                            f"extra_loggers entry {name!r} names a perf "
+                            f"logger never declared anywhere "
+                            f"(coll.create/PerfCounters): the MgrClient "
+                            f"report merge skips unknown loggers "
+                            f"silently, so its counters never appear "
+                            f"in the exporter's /metrics families"))
     return out

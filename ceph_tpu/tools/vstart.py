@@ -117,27 +117,20 @@ class VCluster:
         return c
 
     async def stop(self) -> None:
+        # bounded_stop, not bare wait_for: a timeout must REAP the
+        # half-finished daemon stop (cancel + await) instead of
+        # abandoning it, or its connection/dispatch tasks are destroyed
+        # pending at loop close (the BENCH_r05 teardown spam)
+        from ceph_tpu.utils.async_util import bounded_stop
         for daemon in (self.rgw, self.mds, self.mgr):
             if daemon is not None:
-                try:
-                    await asyncio.wait_for(daemon.stop(), 20)
-                except Exception:
-                    pass
+                await bounded_stop(daemon.stop(), 20)
         for c in self.clients:
-            try:
-                await asyncio.wait_for(c.shutdown(), 20)
-            except Exception:
-                pass
+            await bounded_stop(c.shutdown(), 20)
         for osd in list(self.osds.values()):
-            try:
-                await asyncio.wait_for(osd.stop(), 20)
-            except Exception:
-                pass
+            await bounded_stop(osd.stop(), 20)
         for mon in self.mons.values():
-            try:
-                await asyncio.wait_for(mon.stop(), 20)
-            except Exception:
-                pass
+            await bounded_stop(mon.stop(), 20)
 
     def status(self) -> dict:
         leader = next((m for m in self.mons.values()

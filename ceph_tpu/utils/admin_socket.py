@@ -65,6 +65,15 @@ class AdminSocket:
                               "collected op trace spans grouped by trace")
         self.register_command("trace reset", lambda req: tracer.reset(),
                               "clear the span collector")
+        from ceph_tpu.utils import loopprof
+        self.register_command(
+            "profile dump",
+            lambda req: loopprof.dump(req.get("top")),
+            "loop profiler: busy fraction, executor depth, top stall "
+            "sites (arm with config set profiler_enabled true)")
+        self.register_command("profile reset",
+                              lambda req: loopprof.reset(),
+                              "zero the loop profiler's samples")
         if self.config is not None:
             self.register_command("config show",
                                   lambda req: self.config.show(),

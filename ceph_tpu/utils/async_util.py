@@ -91,6 +91,38 @@ async def drain_all(tasks: Iterable[asyncio.Task | None]) -> None:
         await drain(t)
 
 
+async def bounded_stop(coro, timeout: float) -> bool:
+    """Await a teardown coroutine under a deadline WITHOUT leaking it.
+
+    The old pattern — `asyncio.wait_for(daemon.stop(), 20)` inside
+    `except Exception: pass` — cancels a slow stop() halfway through
+    its own reaping and abandons it, leaving connection/dispatch tasks
+    pending at loop close ("Task was destroyed but it is pending!", the
+    BENCH_r05 tail spam). Here the timeout instead REAPS the
+    half-finished teardown (cancel + await), so everything it owns is
+    done before we return. Returns True when the stop completed
+    cleanly, False on timeout or failure."""
+    task = asyncio.get_running_loop().create_task(coro)
+    try:
+        await asyncio.wait_for(asyncio.shield(task), timeout)
+        return True
+    except asyncio.TimeoutError:
+        # the reap gets its own deadline: a stop() that swallows the
+        # injected cancel (or whose finally awaits a wedged peer) must
+        # not hang teardown forever — abandoning it, and eating one
+        # destroyed-pending report, is the last resort
+        try:
+            await asyncio.wait_for(reap(task), timeout)
+        except asyncio.TimeoutError:
+            pass
+        return False
+    except asyncio.CancelledError:
+        await reap(task)
+        raise
+    except Exception:
+        return False
+
+
 # -- executor-backed file I/O -------------------------------------------------
 # Sync open()/read()/write() inside a coroutine stalls the whole event
 # loop behind one syscall (radoslint: blocking-in-coroutine). The CLI

@@ -9,7 +9,11 @@ interop directly with the codec data path.
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
+
+from ceph_tpu.utils import copytrack
 
 
 class Ptr:
@@ -64,17 +68,23 @@ class BufferList:
         if isinstance(data, BufferList):
             self._ptrs.extend(data._ptrs)
             self._length += data._length
+            copytrack.referenced("frame_to_buffer", data._length)
         elif isinstance(data, Ptr):
             self._ptrs.append(data)
             self._length += data.length
+            copytrack.referenced("frame_to_buffer", data.length)
         elif isinstance(data, np.ndarray):
             arr = data.reshape(-1).view(np.uint8)
             self._ptrs.append(Ptr(arr))
             self._length += arr.size
+            copytrack.referenced("frame_to_buffer", arr.size)
         else:
+            t0 = time.perf_counter()
             arr = np.frombuffer(bytes(data), dtype=np.uint8).copy()
             self._ptrs.append(Ptr(arr, owned=True))
             self._length += arr.size
+            copytrack.copied("frame_to_buffer", arr.size,
+                             time.perf_counter() - t0)
         self._invalidate()
         return self
 
@@ -129,7 +139,11 @@ class BufferList:
             return np.zeros(0, dtype=np.uint8)
         if len(self._ptrs) == 1:
             return self._ptrs[0].view()
-        return np.concatenate([p.view() for p in self._ptrs])
+        t0 = time.perf_counter()
+        out = np.concatenate([p.view() for p in self._ptrs])
+        copytrack.copied("buffer_to_staging", out.size,
+                         time.perf_counter() - t0)
+        return out
 
     def to_bytes(self) -> bytes:
         return self.to_array().tobytes()
@@ -137,7 +151,10 @@ class BufferList:
     def rebuild(self) -> None:
         """Coalesce into one contiguous segment (buffer::list::rebuild)."""
         if len(self._ptrs) > 1:
+            t0 = time.perf_counter()
             arr = np.concatenate([p.view() for p in self._ptrs])
+            copytrack.copied("buffer_to_staging", arr.size,
+                             time.perf_counter() - t0)
             self._ptrs = [Ptr(arr, owned=True)]
             self._invalidate()
 
@@ -149,7 +166,10 @@ class BufferList:
         pad = (-arr.size) % align
         owned = pad > 0 or len(self._ptrs) != 1 or self._ptrs[0].owned
         if pad:
+            t0 = time.perf_counter()
             arr = np.concatenate([arr, np.zeros(pad, dtype=np.uint8)])
+            copytrack.copied("buffer_to_staging", arr.size,
+                             time.perf_counter() - t0)
             self._ptrs = [Ptr(arr, 0, self._length, owned=True)]
         else:
             self._ptrs = [Ptr(arr, owned=owned)]

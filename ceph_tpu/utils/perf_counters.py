@@ -158,11 +158,17 @@ class PerfCountersCollection:
             return cls._instance
 
     def create(self, name: str) -> PerfCounters:
+        return self.register(PerfCounters(name))
+
+    def register(self, pc: PerfCounters) -> PerfCounters:
+        """Insert an already-built (possibly subclassed) PerfCounters —
+        pull-model loggers like the copyflow ledger mirror override
+        dump() and register themselves here."""
         with self._lock:
-            if name in self._loggers:
-                raise ValueError(f"perf counters {name} already registered")
-            pc = PerfCounters(name)
-            self._loggers[name] = pc
+            if pc.name in self._loggers:
+                raise ValueError(
+                    f"perf counters {pc.name} already registered")
+            self._loggers[pc.name] = pc
             return pc
 
     def remove(self, name: str) -> None:

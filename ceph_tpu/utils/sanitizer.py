@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import traceback
+import sys
 import weakref
 
 from ceph_tpu.utils.dout import dout
@@ -79,16 +79,38 @@ def spawn_site(task: asyncio.Task) -> str | None:
     frames = getattr(task, "_san_spawn_stack", None)
     if not frames:
         return None
-    return " <- ".join(f"{f.filename}:{f.lineno} in {f.name}"
-                       for f in reversed(frames))
+    return " <- ".join(f"{fn}:{ln} in {name}"
+                       for fn, ln, name in frames)
 
 
 def _task_factory(loop, coro, **kwargs):
     task = asyncio.Task(coro, loop=loop, **kwargs)
-    # drop the factory/create_task frames; keep the caller's tail
-    task._san_spawn_stack = traceback.extract_stack(limit=8)[:-1]
+    # raw frame walk, innermost-first, skipping the create_task/factory
+    # machinery. NOT traceback.extract_stack: that reads (and
+    # stat()s!) source files through linecache per spawn, which the
+    # loop profiler measured at ~60% of a busy OSD loop — the sanitizer
+    # must observe the loop, not load it.
+    frames = []
+    f = sys._getframe(1)
+    while f is not None and len(frames) < 7:
+        code = f.f_code
+        if "/asyncio/" not in code.co_filename:
+            frames.append((code.co_filename, f.f_lineno, code.co_name))
+        f = f.f_back
+    task._san_spawn_stack = frames
     perf().inc("san_tasks_created")
     return task
+
+
+#: public handle: the loop profiler (utils/loopprof.py) arms this same
+#: factory so sampled tasks carry their spawn sites, and teardown can
+#: recognize (and correctly unwind) a factory it installed
+task_factory = _task_factory
+
+
+def armed(loop: asyncio.AbstractEventLoop) -> bool:
+    """True while install() holds this loop (debug mode + factory)."""
+    return loop in _installed_loops
 
 
 class _SlowCallbackBridge(logging.Handler):

@@ -26,18 +26,40 @@ Design:
 """
 from __future__ import annotations
 
+import asyncio
 import collections
 import contextvars
 import random
 import threading
 import time
+import weakref
 from typing import Any, Iterator
 
 #: (trace_id, span_id) of the span the current task is inside, if any
 _current: contextvars.ContextVar[tuple[int, int] | None] = \
     contextvars.ContextVar("trace_ctx", default=None)
 
+#: task -> NAME of the span it is currently inside. The loop profiler
+#: attributes sampled wall time to this ("which span kind was running
+#: when the loop stalled") by reading the loop's current task from its
+#: sampler thread — a contextvar can't serve that on 3.10 (no
+#: Task.get_context), so the span CM mirrors its name here. Weak keys:
+#: a finished task drops its entry with it.
+_task_spans: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
 _enabled = False
+
+
+def task_span_name(task) -> str | None:
+    """Name of the span `task` is currently inside (None when it isn't
+    in one, or tracing is off). Safe to call from a foreign thread —
+    the sampler reads the loop's current task through this."""
+    if task is None:
+        return None
+    try:
+        return _task_spans.get(task)
+    except Exception:
+        return None
 
 
 def _new_id() -> int:
@@ -145,17 +167,31 @@ _NOOP = _NoopSpanCM()
 class _SpanCM:
     """Context manager making a live span the current trace context."""
 
-    __slots__ = ("span", "_token")
+    __slots__ = ("span", "_token", "_task", "_prev_name")
 
     def __init__(self, span: Span):
         self.span = span
 
     def __enter__(self) -> Span:
         self._token = _current.set((self.span.trace_id, self.span.span_id))
+        self._task = self._prev_name = None
+        try:
+            task = asyncio.current_task()
+        except RuntimeError:
+            task = None
+        if task is not None:
+            self._task = task
+            self._prev_name = _task_spans.get(task)
+            _task_spans[task] = self.span.name
         return self.span
 
     def __exit__(self, et, ev, tb) -> bool:
         _current.reset(self._token)
+        if self._task is not None:
+            if self._prev_name is None:
+                _task_spans.pop(self._task, None)
+            else:
+                _task_spans[self._task] = self._prev_name
         if et is not None:
             self.span.tags.setdefault("error", f"{et.__name__}: {ev}")
         self.span.finish()
@@ -232,6 +268,24 @@ def enable(max_spans: int | None = None) -> None:
 def disable() -> None:
     global _enabled
     _enabled = False
+
+
+#: attribution-profiler mode: when set, the tpu plugin's traced
+#: dispatches synchronize each pipeline stage so spans carry REAL
+#: h2d/kernel/d2h splits — at the cost of the transfer/compute overlap.
+#: Deliberately NOT implied by `tracer_enabled`: routine tracing must
+#: stay cheap enough to leave on, so only the bench attribution stage
+#: (or an operator who wants the waterfall) opts in.
+_profile_dispatch = False
+
+
+def profile_dispatch() -> bool:
+    return _profile_dispatch
+
+
+def set_profile_dispatch(on: bool) -> None:
+    global _profile_dispatch
+    _profile_dispatch = bool(on)
 
 
 def register_config(config) -> None:

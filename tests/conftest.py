@@ -72,3 +72,13 @@ def _no_pending_task_leaks():
         f"{len(fresh)} asyncio task(s) destroyed while pending — a "
         f"daemon/messenger teardown failed to cancel-and-await them:\n"
         + "\n".join(fresh[:10]))
+    # the loop sampling profiler must unwind with the test's loop: a
+    # still-armed loop means an uninstall() was skipped, and the task
+    # factory it installed would bleed spawn-site recording (and a
+    # daemon sampler thread) into every later test
+    from ceph_tpu.utils import loopprof
+    live = loopprof.installed_loops()
+    assert not live, (
+        f"loop profiler still armed on {len(live)} loop(s) after the "
+        f"test — loopprof.uninstall() (or profiler_enabled=false) "
+        f"missing from teardown")

@@ -14,3 +14,12 @@ def use(config):
 def leak(tracer):
     sp = tracer.start_span("orphan_span")       # never finish()ed
     return 1
+
+
+class _MirrorCounters(PerfCounters):
+    """Pull-model logger mirror whose counter nobody ever syncs."""
+
+    def __init__(self):
+        super().__init__("mirror")
+        self.add("subclass_ghost_counter",
+                 description="declared on self, never set")

@@ -14,3 +14,16 @@ def use(config, perf):
 def spans(tracer):
     sp = tracer.start_span("balanced_span")
     sp.finish()
+
+
+class _MirrorCounters(PerfCounters):
+    """Pull-model mirror: declared on self, synced at dump() time."""
+
+    def __init__(self):
+        super().__init__("mirror")
+        self.add("subclass_live_counter",
+                 description="set from dump below")
+
+    def dump(self):
+        self.set("subclass_live_counter", 1)
+        return super().dump()

@@ -1,0 +1,9 @@
+"""Known-positive report-export-consistency: an extra_loggers entry
+naming a perf logger nobody declares — the MgrClient report merge skips
+it silently and the exporter family never materializes."""
+
+
+def wire(MgrClient, messenger, coll):
+    coll.create("declared_logger")
+    return MgrClient(messenger, "osd.0", "osd",
+                     extra_loggers=("declared_logger", "ghost_logger"))

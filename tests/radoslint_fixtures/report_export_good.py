@@ -1,0 +1,22 @@
+"""Known-negative report-export-consistency: every extra_loggers entry
+matches a declared perf logger (create(), PerfCounters(), or a
+pull-model subclass's super().__init__ name)."""
+
+
+class PerfCounters:
+    def __init__(self, name):
+        self.name = name
+
+
+class _MirrorCounters(PerfCounters):
+    def __init__(self):
+        super().__init__("mirror_logger")
+
+
+def wire(MgrClient, messenger, coll, PerfCounters):
+    coll.create("created_logger")
+    PerfCounters("constructed_logger")
+    return MgrClient(messenger, "osd.0", "osd",
+                     extra_loggers=("created_logger",
+                                    "constructed_logger",
+                                    "mirror_logger"))

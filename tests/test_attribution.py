@@ -1,0 +1,297 @@
+"""Attribution-profiler tests (ISSUE 6): byte-exact copy-ledger
+accounting over a known pipeline, the event-loop sampling profiler
+(synthetic blocking callback surfaces in `profile dump`, hot-toggle via
+config, task-factory unwind), per-device offload utilization (fallback
+batches attributed to `host`), the bench attribution waterfall math
+(buckets + residual sum to op_total), and the report→exporter contract
+(`ceph_device`-labeled families, every report-merged logger renderable).
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu import offload
+from ceph_tpu.ec import registry
+from ceph_tpu.mgr.daemon import DaemonStateIndex
+from ceph_tpu.mgr.exporter import render_metrics
+from ceph_tpu.msg.frames import Frame, Tag
+from ceph_tpu.tools.bench_driver import (ATTRIBUTION_BUCKETS,
+                                         attribution_from_spans)
+from ceph_tpu.utils import copytrack, loopprof
+from ceph_tpu.utils.admin_socket import AdminSocket
+from ceph_tpu.utils.buffer import BufferList
+from ceph_tpu.utils.config import Config
+from ceph_tpu.utils.perf_counters import PerfCountersCollection
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    """The ledger is process-wide; each test reads its own deltas."""
+    copytrack.reset()
+    yield
+    copytrack.reset()
+
+
+# ---------------------------------------------------------------------------
+# copy ledger: known pipeline -> exact bytes-copied
+# ---------------------------------------------------------------------------
+
+def test_ledger_frame_tx_rx_exact_bytes():
+    segs = [b"a" * 512, b"b" * 256]
+    blob = Frame(Tag.MESSAGE, segs).encode()
+    snap = copytrack.snapshot()["stages"]
+    # tx copies every segment byte into the wire blob, then bytes()
+    # materializes the blob once more: 2x the segment payload
+    assert snap["frame_tx"]["copied_bytes"] == 2 * 768
+    assert snap["frame_tx"]["events"] == 1
+    assert snap["frame_rx"]["copied_bytes"] == 0
+    Frame.decode(blob)
+    snap = copytrack.snapshot()["stages"]
+    # rx slices each segment back out of the blob: 1x the payload
+    assert snap["frame_rx"]["copied_bytes"] == 768
+
+
+def test_ledger_bufferlist_copy_vs_reference():
+    bl = BufferList()
+    bl.append(b"x" * 100)                   # bytes -> owned copy
+    snap = copytrack.snapshot()["stages"]["frame_to_buffer"]
+    assert snap["copied_bytes"] == 100
+    assert snap["referenced_bytes"] == 0
+    bl.append(np.zeros(50, dtype=np.uint8))  # ndarray -> window, no copy
+    snap = copytrack.snapshot()["stages"]["frame_to_buffer"]
+    assert snap["copied_bytes"] == 100
+    assert snap["referenced_bytes"] == 50
+    bl.to_array()                            # 2 ptrs -> one concatenate
+    staging = copytrack.snapshot()["stages"]["buffer_to_staging"]
+    assert staging["copied_bytes"] == 150
+
+
+def test_ledger_amplification_and_totals():
+    copytrack.copied("h2d", 300, 0.001)
+    copytrack.referenced("buffer_to_staging", 1000)
+    copytrack.copied("d2h", 100)
+    assert copytrack.amplification(100) == 4.0     # (300+100)/100
+    assert copytrack.amplification(0) == 0.0
+    snap = copytrack.snapshot()
+    assert snap["copied_bytes_total"] == 400
+    assert snap["referenced_bytes_total"] == 1000
+    assert snap["copy_seconds_total"] == pytest.approx(0.001)
+
+
+def test_ledger_perf_counter_mirror_syncs_on_dump():
+    pc = copytrack.perf()
+    assert PerfCountersCollection.instance().get("copyflow") is pc
+    copytrack.copied("h2d", 128, 0.002)
+    dump = pc.dump()
+    assert dump["copied_bytes_h2d"] == 128
+    assert dump["copy_micros_h2d"] == 2000
+    # the mirror is pull-model: a later ledger reset zeroes it too
+    copytrack.reset()
+    assert pc.dump()["copied_bytes_h2d"] == 0
+
+
+# ---------------------------------------------------------------------------
+# event-loop sampling profiler
+# ---------------------------------------------------------------------------
+
+def test_sampler_blocking_callback_shows_in_profile_dump():
+    async def body():
+        loop = asyncio.get_running_loop()
+        assert loop.get_task_factory() is None
+        loopprof.install(sample_hz=400)
+        loopprof.reset()
+        # synthetic blocking callback: hot-spin on the loop thread in
+        # slices until the sampler has caught us in the act
+        t_end = time.perf_counter() + 3.0
+        while time.perf_counter() < t_end:
+            t_slice = time.perf_counter() + 0.05
+            while time.perf_counter() < t_slice:
+                pass
+            if loopprof.dump()["busy_samples"] >= 5:
+                break
+        d = loopprof.dump(top_n=20)
+        loopprof.uninstall()
+        # factory unwound with the loop (the conftest leak gate asserts
+        # installed_loops() empties; this asserts the factory half)
+        assert loop.get_task_factory() is None
+        return d
+
+    d = asyncio.run(body())
+    assert d["busy_samples"] >= 5
+    assert 0.0 < d["loop_busy_fraction"] <= 1.0
+    assert d["sample_hz"] == 400.0
+    sites = [s["site"] for s in d["top_stalls"]]
+    assert any("test_attribution.py" in s for s in sites), sites
+    assert loopprof.installed_loops() == []
+
+
+def test_sampler_hot_toggle_via_config_and_reset():
+    cfg = Config()
+    loopprof.register_config(cfg)
+    assert cfg.get("profiler_enabled") is False
+
+    async def body():
+        loop = asyncio.get_running_loop()
+        loopprof.maybe_install(cfg)          # disabled: tracks, no arm
+        assert loop not in loopprof.installed_loops()
+        cfg.set("profiler_enabled", True)    # observer arms live
+        assert loop in loopprof.installed_loops()
+        cfg.set("profiler_enabled", False)   # ... and disarms live
+        assert loop not in loopprof.installed_loops()
+
+    asyncio.run(body())
+    cleared = loopprof.reset()
+    assert cleared["cleared_samples"] >= 0
+    assert loopprof.dump()["samples"] == 0
+
+
+def test_profile_dump_admin_socket_command(tmp_path):
+    asok = AdminSocket(str(tmp_path / "t.asok"))
+    out = asok.execute({"prefix": "profile dump"})["result"]
+    assert set(out) >= {"enabled", "loop_busy_fraction", "samples",
+                        "executor_queue_depth", "top_stalls"}
+    assert asok.execute({"prefix": "profile reset"})[
+        "result"]["cleared_samples"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# per-device offload utilization
+# ---------------------------------------------------------------------------
+
+def _impl(k=4, m=2):
+    return registry.factory("tpu", {"k": str(k), "m": str(m)})
+
+
+def test_device_batches_and_fallback_attribution():
+    async def body():
+        impl = _impl()
+        svc = offload.get_service()
+        stripes = np.zeros((2, 4, 1024), dtype=np.uint8)
+        await svc.encode(impl, stripes)
+        # healthy dispatch lands on the jax device label (cpu:N here)
+        dev_keys = [k for k in svc.device_stats if k != "host"]
+        assert len(dev_keys) == 1
+        d = svc.device_stats[dev_keys[0]]
+        assert d["batches"] >= 1 and d["ops"] >= 1
+        assert d["bytes"] >= stripes.nbytes
+        assert d["busy_s"] > 0.0
+        assert d["fallback_ops"] == 0
+        # now break the device path: the fallback batch must be
+        # attributed to the fixed "host" label
+        impl.encode_stripes = lambda batch: (_ for _ in ()).throw(
+            RuntimeError("device gone"))
+        await svc.encode(impl, stripes)
+        host = svc.device_stats["host"]
+        assert host["fallback_ops"] >= 1
+        assert host["batches"] >= 1
+        assert host["busy_s"] > 0.0
+        # the report-path view mirrors the same attribution
+        dm = svc.device_metrics()
+        assert dm["host"]["offload_device_fallback_ops"] >= 1
+        assert dm[dev_keys[0]]["offload_device_ops"] >= 1
+        assert svc.status()["devices"][dev_keys[0]]["ops"] >= 1
+
+    asyncio.run(body())
+
+
+# ---------------------------------------------------------------------------
+# bench attribution waterfall math
+# ---------------------------------------------------------------------------
+
+def _span(trace, name, dur, **tags):
+    return {"trace_id": trace, "name": name, "duration_us": dur,
+            "tags": tags}
+
+
+def test_attribution_buckets_sum_to_op_total():
+    spans = [
+        _span("t1", "osd_op", 1000.0, queue_wait_us=200.0),
+        _span("t1", "offload_batch", 300.0, copy_us=50.0),
+        _span("t1", "tpu_encode_dispatch", 400.0, h2d_us=100.0,
+              kernel_us=250.0, d2h_us=50.0),
+        _span("t1", "store_commit", 150.0),
+        _span("t1", "store_commit", 120.0),     # parallel shard: max wins
+        _span("t2", "offload_batch", 10.0),     # orphan trace: ignored
+    ]
+    att = attribution_from_spans(spans)
+    assert att["ops"] == 1
+    assert att["op_total_us"] == 1200.0          # 1000 span + 200 queued
+    b = att["buckets_us"]
+    assert b["queue_wait"] == 200.0
+    assert b["copy"] == 50.0
+    assert b["h2d"] == 100.0
+    assert b["kernel"] == 250.0
+    assert b["d2h"] == 50.0
+    assert b["commit"] == 150.0
+    assert b["other"] == 400.0                   # explicit residual
+    total = sum(b[k] for k in ATTRIBUTION_BUCKETS)
+    assert total == pytest.approx(att["op_total_us"], rel=0.10)
+    assert att["attributed_fraction"] == pytest.approx(800.0 / 1200.0,
+                                                       abs=1e-4)
+    assert sum(att["bucket_pct"].values()) == pytest.approx(100.0, abs=0.5)
+
+
+def test_attribution_empty_and_multi_op():
+    assert attribution_from_spans([])["ops"] == 0
+    spans = [
+        _span("t1", "osd_op", 500.0, queue_wait_us=100.0),
+        _span("t2", "osd_op", 700.0),
+        _span("t2", "store_commit", 200.0),
+    ]
+    att = attribution_from_spans(spans)
+    assert att["ops"] == 2
+    assert att["op_total_us"] == pytest.approx((600.0 + 700.0) / 2)
+    assert att["buckets_us"]["queue_wait"] == pytest.approx(50.0)
+    assert att["buckets_us"]["commit"] == pytest.approx(100.0)
+
+
+# ---------------------------------------------------------------------------
+# report -> exporter family contract
+# ---------------------------------------------------------------------------
+
+def test_device_metrics_render_with_ceph_device_label():
+    index = DaemonStateIndex()
+    index.report({
+        "daemon_name": "osd.0", "service": "osd",
+        "schema": {"copyflow_copied_bytes_h2d": {"type": "counter"}},
+        "counters": {"copyflow_copied_bytes_h2d": 4096},
+        "device_metrics": {
+            "tpu:0": {"offload_device_bytes": 123,
+                      "offload_device_busy_seconds": 0.5},
+            "host": {"offload_device_bytes": 7}},
+    })
+    text = render_metrics(None, index=index)
+    assert ('ceph_offload_device_bytes{ceph_daemon="osd.0",'
+            'ceph_device="tpu:0"} 123') in text
+    assert ('ceph_offload_device_bytes{ceph_daemon="osd.0",'
+            'ceph_device="host"} 7') in text
+    assert 'ceph_device="tpu:0"} 0.5' in text
+    # the ledger counter merged from the report renders as a family too
+    assert "# TYPE ceph_copyflow_copied_bytes_h2d counter" in text
+    # exactly one TYPE line per family
+    assert text.count("# TYPE ceph_offload_device_bytes ") == 1
+
+
+def test_every_report_merged_logger_is_exportable():
+    """The runtime half of radoslint's report-export-consistency rule:
+    every extra_loggers name the OSD merges into its MgrClient reports
+    must resolve in the process-wide collection once armed, so its
+    counters reach the exporter family list."""
+    from ceph_tpu.utils import sanitizer
+    copytrack.perf()
+    loopprof.perf()
+    sanitizer.perf()
+
+    async def body():
+        offload.get_service()       # registers the "offload" logger
+
+    asyncio.run(body())
+    coll = PerfCountersCollection.instance()
+    for name in ("offload", "sanitizer", "loopprof", "copyflow"):
+        pc = coll.get(name)
+        assert pc is not None, f"extra_logger {name!r} unregistered"
+        assert pc.dump(), f"logger {name!r} exports no counters"

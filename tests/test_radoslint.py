@@ -19,7 +19,8 @@ FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "radoslint_fixtures")
 
 ALL_RULES = {"detached-task", "blocking-in-coroutine", "await-under-lock",
-             "cancellation-swallow", "registry-consistency", "decl-use"}
+             "cancellation-swallow", "registry-consistency", "decl-use",
+             "report-export-consistency"}
 
 
 def lint(path, rules):
@@ -36,7 +37,9 @@ def lint(path, rules):
      "await_under_lock_neg.py"),
     ("cancellation-swallow", "cancellation_swallow_pos.py", 2,
      "cancellation_swallow_neg.py"),
-    ("decl-use", "decl_use_bad.py", 4, "decl_use_good.py"),
+    ("decl-use", "decl_use_bad.py", 5, "decl_use_good.py"),
+    ("report-export-consistency", "report_export_bad.py", 1,
+     "report_export_good.py"),
 ])
 def test_rule_fixtures(rule, pos, expected, neg):
     findings = lint(pos, rules=[rule])
@@ -64,6 +67,7 @@ def test_rule_ids_match_registered_set():
     kinds = {r.id: r.kind for r in core.RULES.values()}
     assert kinds["registry-consistency"] == "project"
     assert kinds["decl-use"] == "project"
+    assert kinds["report-export-consistency"] == "project"
 
 
 # -- suppression comments ----------------------------------------------------
