@@ -40,6 +40,7 @@ CPU_TIMEOUT = 420
 DEVICE_TIMEOUT = 900  # single long warm: backend init + benches, one child
 CLUSTER_TPU_TIMEOUT = 420  # in-situ EC-over-tpu cluster stage
 ATTRIBUTION_TIMEOUT = 240  # hermetic attribution-profiler stage
+FAILURE_STORM_TIMEOUT = 320  # kill/revive resilience + repair-ratio stage
 METRIC = "ec_encode_k8m3_1MiB_chunk"
 
 _deadline = time.monotonic() + TOTAL_BUDGET
@@ -160,6 +161,15 @@ def main() -> int:
                             _budget(ATTRIBUTION_TIMEOUT))
     stages["attribution"] = attribution
 
+    # Stage 5: failure storm — kill m=3 of 11 OSDs under sustained EC
+    # (clay k=8,m=3) client load, degraded reads served throughout,
+    # revive, time-to-clean + recovery MB/s + backfill p99, then the
+    # single-shard repair-bytes ratio vs the full-stripe baseline.
+    # Hermetic: it measures degraded OPERATION, not codec speed.
+    storm = run_stage("failure_storm", _hermetic_env(),
+                      _budget(FAILURE_STORM_TIMEOUT))
+    stages["failure_storm"] = storm
+
     detail = {k: v for k, v in cpu.items()
               if k not in ("status", "elapsed_s", "stderr_tail")}
     detail.update({k: v for k, v in cluster.items()
@@ -170,6 +180,8 @@ def main() -> int:
     detail.update({k: v for k, v in attribution.items()
                    if k not in ("status", "elapsed_s", "stderr_tail",
                                 "attribution")})
+    detail.update({k: v for k, v in storm.items()
+                   if k not in ("status", "elapsed_s", "stderr_tail")})
     detail.update({k: v for k, v in device.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})
 

@@ -308,6 +308,8 @@ class MgrDaemon(Dispatcher):
                 except Exception as e:
                     dout("mgr", 2, f"mgr module {mod.NAME} failed: "
                                    f"{type(e).__name__} {e}")
+                    from ceph_tpu.utils import crash
+                    crash.record(f"mgr.{self.name}", e)
             await asyncio.sleep(self.TICK_INTERVAL)
 
     def _build_digest(self) -> dict:
@@ -320,8 +322,18 @@ class MgrDaemon(Dispatcher):
         degraded, undersized = [], []
         nearfull, full = [], []
         offload_degraded = []
+        crashed = []
+        # the mgr's own crash records never travel a report session
+        # (it does not report to itself): consult the local registry so
+        # a crash-looping mgr module raises RECENT_CRASH too
+        from ceph_tpu.utils import crash as crash_mod
+        own = len(crash_mod.recent(f"mgr.{self.name}"))
+        if own:
+            crashed.append((f"mgr.{self.name}", own))
         for name, st in sorted(self.daemon_index.daemons.items()):
             hm = st.health_metrics or {}
+            if hm.get("recent_crashes"):
+                crashed.append((name, int(hm["recent_crashes"])))
             n = int(hm.get("slow_ops") or 0)
             if n:
                 slow_total += n
@@ -373,6 +385,17 @@ class MgrDaemon(Dispatcher):
                 "severity": "HEALTH_ERR",
                 "summary": f"{len(full)} osds full",
                 "detail": [f"{d} is {u:.0%} full" for d, u in full]}
+        if crashed:
+            # unarchived crash records (the reference crash module's
+            # RECENT_CRASH): `crash archive` over the daemon's admin
+            # socket acknowledges them and clears the check
+            checks["RECENT_CRASH"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{sum(n for _, n in crashed)} recent "
+                           f"crash records on {len(crashed)} daemons "
+                           f"(crash ls / crash archive)",
+                "detail": [f"{d}: {n} unarchived crash records"
+                           for d, n in crashed]}
         if offload_degraded:
             # the EC data path still serves (host-codec fallback is
             # bit-identical) but at host speed: warn, don't err

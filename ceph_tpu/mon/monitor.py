@@ -215,12 +215,14 @@ class OSDMonitor:
             # "indep"; OSDMonitor crush_rule_create_erasure :7470)
             crush.make_simple_rule(rule_id, f"{name}_rule", "default",
                                    crush_failure_domain, mode="indep")
-            # chunk size honors the plugin's alignment (the reference
-            # derives stripe_width through get_chunk_size the same way,
-            # OSDMonitor prepare_new_pool): bitmatrix techniques need
-            # chunks divisible by w, not just SIMD-aligned
-            align = ec.get_alignment()
-            chunk = -(-4096 // align) * align
+            # chunk size through the plugin's own get_chunk_size (the
+            # reference derives stripe_width the same way, OSDMonitor
+            # prepare_new_pool): bitmatrix techniques need chunks
+            # divisible by w, and sub-chunk codes (clay) need chunks
+            # divisible by sub_chunk_no — alignment-only math broke
+            # clay at k=8,m=3,d=10 (sub_chunk_no=81 does not divide a
+            # 128-aligned 4096 chunk)
+            chunk = ec.get_chunk_size(k * 4096)
             stripe_width = k * chunk
         else:
             min_size = max(1, size - 1)

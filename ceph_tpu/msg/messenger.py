@@ -35,6 +35,7 @@ from typing import Awaitable, Callable
 
 from ceph_tpu.msg.frames import BANNER, Frame, FrameError, Tag, Onwire
 from ceph_tpu.msg.messages import Message
+from ceph_tpu.qa import faultinject
 from ceph_tpu.utils import tracer
 from ceph_tpu.utils.async_util import being_cancelled, drain_all, reap, \
     reap_all
@@ -435,6 +436,24 @@ class Connection:
                 if msg.seq <= self.in_seq:
                     continue                      # replayed duplicate
                 self.in_seq = msg.seq
+                if faultinject.armed():
+                    # deterministic fault injection AFTER seq accounting:
+                    # a dropped message is permanently lost (later
+                    # dispatches advance the processed-seq ack past it,
+                    # like real on-path loss); a dup re-enters dispatch
+                    # twice (the dup-op table's exercise); a delay
+                    # reorders it behind later arrivals
+                    act, delay = faultinject.on_message(
+                        self.messenger.entity_name, msg)
+                    if act == "drop":
+                        continue
+                    if act == "dup":
+                        self._dispatch_q.put_nowait(
+                            (self._session_gen, msg))
+                    elif act == "delay":
+                        self._spawn(self._deliver_delayed(
+                            self._session_gen, msg, delay))
+                        continue
                 self._dispatch_q.put_nowait((self._session_gen, msg))
             elif frame.tag == Tag.ACK:
                 (seq,) = json.loads(frame.segments[0])
@@ -445,6 +464,15 @@ class Connection:
                 pass
             else:
                 raise FrameError(f"unexpected tag {frame.tag} mid-session")
+
+    async def _deliver_delayed(self, gen: int, msg: Message,
+                               delay: float) -> None:
+        """Injected message delay: re-enters the dispatch queue after
+        sleeping, so later arrivals overtake it (ms_inject_delay_max
+        semantics)."""
+        await asyncio.sleep(delay)
+        if not self._closed:
+            self._dispatch_q.put_nowait((gen, msg))
 
     async def _dispatch_loop(self) -> None:
         """Consume read messages in order, independent of the transport.

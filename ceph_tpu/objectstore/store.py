@@ -250,6 +250,26 @@ class ObjectStore:
              length: int | None = None) -> bytes:
         raise NotImplementedError
 
+    def corrupt(self, cid: CollectionId, oid: Ghobject, offset: int = 0,
+                xor: int = 0x01) -> bool:
+        """Fault-injection hook: flip bits of one stored byte in place
+        through a normal write transaction. Store-level checksums (the
+        BlueStore per-AU csums) follow the write — exactly like silent
+        media rot below them — so the HIGHER-layer integrity machinery
+        (EC per-chunk crc attrs, scrub shard comparison) is what must
+        catch it. Returns False when the object is absent or empty."""
+        try:
+            data = self.read(cid, oid)
+        except StoreError:
+            return False
+        if not data:
+            return False
+        offset = min(max(0, int(offset)), len(data) - 1)
+        txn = Transaction()
+        txn.write(cid, oid, offset, bytes([data[offset] ^ (xor or 0x01)]))
+        self.queue_transaction(txn)
+        return True
+
     def getattr(self, cid: CollectionId, oid: Ghobject, name: str) -> bytes:
         raise NotImplementedError
 

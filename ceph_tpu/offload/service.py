@@ -53,6 +53,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ceph_tpu.qa import faultinject
 from ceph_tpu.utils import copytrack, tracer
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import (TYPE_GAUGE, TYPE_HISTOGRAM,
@@ -286,6 +287,43 @@ class OffloadService:
                                   dispatch, fallback,
                                   uses_device=use_device)
 
+    async def repair(self, ec_impl, helpers: tuple[int, ...],
+                     want: tuple[int, ...], frags: np.ndarray,
+                     chunk_size: int) -> np.ndarray:
+        """Sub-chunk regenerating repair units (the CLAY single-shard
+        rebuild): (N, d, repair_per_chunk) helper fragment planes ->
+        (N, chunk_size) rebuilt chunks, coalesced per (codec, erasure
+        pattern, geometry) bucket like any DecodeJob. Host-staged
+        (uses_device=False): the regenerating transform is the plugin's
+        own multi-phase kernel and its success says nothing about the
+        accelerator — the win here is coalescing + leaving the event
+        loop, and the ~qx smaller fetch already happened at the
+        gather."""
+        helpers, want = tuple(helpers), tuple(want)
+        # codec identity by PROFILE, not instance: every PG backend
+        # holds its own plugin object, and keying on id() would defeat
+        # the cross-PG coalescing this job exists for (same profile =>
+        # same deterministic repair math, so any member's impl serves
+        # the whole bucket)
+        try:
+            ident = tuple(sorted(ec_impl.get_profile().items()))
+        except Exception:
+            ident = id(ec_impl)
+        key = ("rep", type(ec_impl).__name__, ident, helpers, want,
+               frags.shape[2], chunk_size)
+
+        def dispatch(batch: np.ndarray) -> np.ndarray:
+            out = np.empty((batch.shape[0], chunk_size), dtype=np.uint8)
+            for u in range(batch.shape[0]):
+                chunks = {h: batch[u, j].tobytes()
+                          for j, h in enumerate(helpers)}
+                dec = ec_impl.decode(list(want), chunks, chunk_size)
+                out[u] = np.frombuffer(dec[want[0]], dtype=np.uint8)
+            return out
+
+        return await self._submit(key, np.ascontiguousarray(frags),
+                                  dispatch, dispatch, uses_device=False)
+
     # -- admission -----------------------------------------------------------
 
     async def _submit(self, key: tuple, data: np.ndarray,
@@ -334,6 +372,8 @@ class OffloadService:
         if self._device_allowed():
             try:
                 t0 = time.perf_counter()
+                if faultinject.should_fail_device():
+                    raise RuntimeError("injected device failure")
                 out = dispatch(data)
                 self._device_success()
                 self._note_device(self._device_label(), 1, nbytes,
@@ -553,6 +593,8 @@ class OffloadService:
         if self._device_allowed():
             try:
                 t0 = time.perf_counter()
+                if faultinject.should_fail_device():
+                    raise RuntimeError("injected device failure")
                 out = await self._in_staging_pool(bucket.dispatch, stacked)
                 self._device_success()
                 self._note_device(self._device_label(), n_ops, nbytes,

@@ -33,14 +33,15 @@ from ceph_tpu.objectstore.memstore import MemStore
 from ceph_tpu.objectstore.store import StoreError
 from ceph_tpu.osd.backend import IntervalChange
 from ceph_tpu.osd.pg import PGInstance
-from ceph_tpu.utils import copytrack, loopprof, sanitizer, tracer
+from ceph_tpu.qa import faultinject
+from ceph_tpu.utils import copytrack, crash, loopprof, sanitizer, tracer
 from ceph_tpu.utils.admin_socket import AdminSocket
 from ceph_tpu.utils.async_util import reap_all
 from ceph_tpu.utils.config import Config, Option
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import (TYPE_AVG, TYPE_HISTOGRAM,
                                           PerfCountersCollection)
-from ceph_tpu.utils.throttle import HeartbeatMap
+from ceph_tpu.utils.throttle import AdjustableSemaphore, HeartbeatMap
 from ceph_tpu.utils.work_queue import (Finisher, OpTracker, ShardedOpQueue,
                                        reset_current_op, set_current_op)
 
@@ -85,8 +86,13 @@ class OSD(Dispatcher):
                    "op queue shards (startup only)", minimum=1),
             Option("osd_max_recovery_in_flight", "int",
                    self.MAX_RECOVERY_IN_FLIGHT,
-                   "host-wide recovery reservation slots (startup only)",
-                   minimum=1),
+                   "host-wide recovery reservation slots (hot: resizes "
+                   "the live pool, so recovery pressure can be tuned "
+                   "mid-storm)", minimum=1),
+            Option("osd_ec_repair_subchunks", "bool", True,
+                   "use regenerating-code sub-chunk repair plans for "
+                   "single-shard recovery (fetch repair fragments from "
+                   "d helpers instead of k whole chunks)"),
         ])
         # op tracing rides the same config (hot-togglable: `config set
         # tracer_enabled true` over the admin socket starts collecting)
@@ -105,6 +111,10 @@ class OSD(Dispatcher):
         # socket): loop-busy-fraction + top stall sites, hot-togglable
         # via `config set profiler_enabled true`
         loopprof.register_config(self.config)
+        # deterministic fault injection (fault_inject_*): `config set
+        # fault_inject_enabled true` over the admin socket arms the
+        # process-wide injector; the `inject` command fires one-shots
+        faultinject.register_config(self.config)
         # the profiler/copy-ledger counter mirrors must exist before the
         # first MgrClient report so their families export from round one
         loopprof.perf()
@@ -120,6 +130,16 @@ class OSD(Dispatcher):
         self.perf.add("subop", description="replication sub-ops applied")
         self.perf.add("recovery_push",
                       description="objects pushed by recovery/backfill")
+        self.perf.add("recovery_bytes_pushed",
+                      description="shard bytes pushed to recovering "
+                                  "peers")
+        self.perf.add("recovery_bytes_fetched",
+                      description="shard bytes fetched by recovery "
+                                  "reconstruction gathers")
+        self.perf.add("recovery_bytes_full_equiv",
+                      description="bytes a full-stripe gather would "
+                                  "have fetched for the same repairs "
+                                  "(repair-bandwidth baseline)")
         self.perf.add("heartbeat_failures",
                       description="peers reported failed to the mon")
         # per-stage latency histograms (power-of-two µs buckets; the
@@ -181,6 +201,12 @@ class OSD(Dispatcher):
                 "ec offload flush",
                 lambda req: self._offload_admin("flush"),
                 "force-flush every pending offload batch bucket")
+            self.asok.register_command(
+                "inject",
+                lambda req: self._inject_admin(req),
+                "fault injection: what=crash|hang|bitrot|msg|device|"
+                "status (hang: seconds; bitrot: oid [offset]; msg: "
+                "action/type/entity/count; device: count)")
         self.messenger = Messenger(f"osd.{whoami}", auth_key=auth_key)
         self.messenger.add_dispatcher(self)
         self.monc = MonClient(self.messenger, mon_addrs)
@@ -218,9 +244,20 @@ class OSD(Dispatcher):
         self._notify_tasks: set[asyncio.Task] = set()
         # host-wide recovery throttle: background pushes across ALL PGs
         # share these slots so backfill cannot monopolize the daemon
-        # (AsyncReserver, src/common/AsyncReserver.h)
-        self.recovery_reservations = asyncio.Semaphore(
+        # (AsyncReserver, src/common/AsyncReserver.h). Resizable live
+        # via the osd_max_recovery_in_flight config observer so
+        # recovery pressure can be tuned mid-storm.
+        self.recovery_reservations = AdjustableSemaphore(
             self.config.get("osd_max_recovery_in_flight"))
+        self.config.add_observer(("osd_max_recovery_in_flight",),
+                                 self._on_recovery_slots)
+        # fault injection: a hang deadline makes dispatch swallow
+        # everything (peers see heartbeat silence -> mark-down); the
+        # crash task is deliberately NOT in _bg_tasks (it runs stop(),
+        # which reaps _bg_tasks — tracking it there would self-deadlock)
+        self._hang_until = 0.0
+        self._crash_task: asyncio.Task | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
         self._booted = asyncio.Event()
         self._hb_task: asyncio.Task | None = None
         self._scrub_task: asyncio.Task | None = None
@@ -229,6 +266,10 @@ class OSD(Dispatcher):
         self._hb_last: dict[int, float] = {}      # peer -> last reply stamp
         self._hb_reported: set[int] = set()
         self._stopping = False
+        # completion latch for concurrent stops (injected crash racing
+        # harness teardown): the second caller WAITS for the first
+        # stop to finish rather than returning mid-teardown
+        self._stop_event: asyncio.Event | None = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -245,6 +286,7 @@ class OSD(Dispatcher):
             self.store.mount()
         from ceph_tpu import offload
         self._offload_svc = offload.get_service()
+        self._loop = asyncio.get_running_loop()
         sanitizer.maybe_install(self.config)
         loopprof.maybe_install(self.config)
         self.op_queue.start()
@@ -305,6 +347,9 @@ class OSD(Dispatcher):
                 "pg_states": states,
                 "degraded_pgs": degraded,
                 "undersized_pgs": undersized,
+                # unarchived crash records for this daemon: the mgr
+                # digests any non-zero count into RECENT_CRASH
+                "recent_crashes": len(crash.recent(f"osd.{self.whoami}")),
                 # device-offload circuit-breaker state: the mgr digests
                 # a degraded service into TPU_OFFLOAD_DEGRADED
                 "offload": (self._offload_svc.health_metrics()
@@ -324,6 +369,112 @@ class OSD(Dispatcher):
         if cmd == "flush":
             return self._offload_svc.flush()
         return self._offload_svc.status()
+
+    # -- fault injection (admin `inject` + injector-driven hooks) ------------
+
+    def _on_recovery_slots(self, name: str, value) -> None:
+        """osd_max_recovery_in_flight observer: resize the live slot
+        pool. Config sets arrive from admin-socket threads; the
+        semaphore is loop-bound, so hop onto the loop when off it."""
+        loop = self._loop
+        on_loop = False
+        if loop is not None and not loop.is_closed():
+            try:
+                on_loop = asyncio.get_running_loop() is loop
+            except RuntimeError:
+                on_loop = False
+            if not on_loop:
+                loop.call_soon_threadsafe(
+                    self.recovery_reservations.resize, int(value))
+                return
+        self.recovery_reservations.resize(int(value))
+
+    def _inject_admin(self, req: dict) -> dict:
+        """`inject` admin-socket verbs — the same injector the config
+        knobs and the failure-storm bench drive."""
+        what = req.get("what", "status")
+        if what == "status":
+            return faultinject.status()
+        if what in ("msg", "device"):
+            # one-shot rules are consulted behind the armed() gate:
+            # arming them with the injector disabled would be a silent
+            # no-op (crash/hang/bitrot fire unconditionally) — auto-arm
+            # and say so, `config set fault_inject_enabled false`
+            # disarms as usual
+            armed_now = not faultinject.armed()
+            if armed_now:
+                faultinject.set_enabled(True)
+            if what == "msg":
+                rule = faultinject.arm_oneshot(
+                    entity=req.get("entity"), msg_type=req.get("type"),
+                    action=req.get("action", "drop"),
+                    count=int(req.get("count", 1)),
+                    delay_ms=req.get("delay_ms"))
+                return {"injected": "msg", "rule": rule,
+                        "armed": armed_now}
+            pending = faultinject.arm_device_failures(
+                int(req.get("count", 1)))
+            return {"injected": "device", "pending": pending,
+                    "armed": armed_now}
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return {"error": "daemon not running"}
+        if what == "crash":
+            loop.call_soon_threadsafe(self._start_crash_task)
+            return {"injected": "crash"}
+        if what == "hang":
+            seconds = float(req.get("seconds", 5.0))
+            loop.call_soon_threadsafe(self._set_hang, seconds)
+            return {"injected": "hang", "seconds": seconds}
+        if what == "bitrot":
+            import concurrent.futures
+            fut = asyncio.run_coroutine_threadsafe(
+                self._inject_bitrot(req["oid"], req.get("offset")), loop)
+            try:
+                return fut.result(timeout=5.0)
+            except concurrent.futures.TimeoutError:
+                fut.cancel()
+                return {"error": "bitrot injection timed out"}
+        return {"error": f"unknown inject target {what!r}"}
+
+    def _start_crash_task(self) -> None:
+        if self._crash_task is None or self._crash_task.done():
+            self._crash_task = asyncio.get_running_loop().create_task(
+                self.fault_crash())
+
+    def _set_hang(self, seconds: float) -> None:
+        self._hang_until = time.monotonic() + max(0.0, seconds)
+        dout("osd", 1, f"osd.{self.whoami} injected hang for "
+                       f"{seconds:.1f}s (dispatch + heartbeats muted)")
+
+    async def fault_crash(self, reason: str = "injected crash") -> None:
+        """Injected daemon death: record the crash, then tear down —
+        peers find out through heartbeat silence, exactly like a kill."""
+        crash.record(f"osd.{self.whoami}", RuntimeError(reason),
+                     backtrace="(injected)")
+        await self.stop()
+
+    async def _inject_bitrot(self, oid: str,
+                             offset=None) -> dict:
+        """Flip one byte of the local shard blob of `oid` (any PG),
+        bypassing csum maintenance — on the loop, so it cannot race a
+        concurrent apply."""
+        for pg in self.pgs.values():
+            if not pg.backend.local_exists(oid):
+                continue
+            cid = pg.backend.coll()
+            gh = pg.backend.ghobject(oid)
+            size = len(self.store.read(cid, gh))
+            if size == 0:
+                return {"error": f"{oid!r} is empty on osd.{self.whoami}"}
+            off = int(offset) if offset is not None else size // 2
+            if self.store.corrupt(cid, gh, off):
+                dout("osd", 1, f"osd.{self.whoami} injected bitrot in "
+                               f"{oid!r} at offset {off}")
+                return {"injected": "bitrot", "oid": oid, "offset": off,
+                        "size": size}
+        return {"error": f"no local shard of {oid!r} on "
+                         f"osd.{self.whoami}"}
 
     def _mgr_progress(self) -> list:
         """Completion fractions for in-flight recovery/backfill (the
@@ -360,6 +511,10 @@ class OSD(Dispatcher):
             e = task.exception()
             dout("osd", 1, f"osd.{self.whoami} background task failed: "
                            f"{type(e).__name__} {e}")
+            # a swallowed fatal exception leaves a crash record behind:
+            # surfaced as RECENT_CRASH through the mgr report path and
+            # listable via `crash ls`
+            crash.record(f"osd.{self.whoami}", e)
 
     async def _scrub_loop(self) -> None:
         """Background scrub scheduler: every SCRUB_INTERVAL, scrub each
@@ -386,11 +541,17 @@ class OSD(Dispatcher):
                 except Exception as e:
                     dout("scrub", 1, f"pg {pg.pgid} scrub failed: "
                                      f"{type(e).__name__} {e}")
+                    crash.record(f"osd.{self.whoami}", e)
 
     async def _reboot_until_up(self) -> None:
         """Resend MOSDBoot until the map shows us up again (mirrors the
         resend loop in start(); survives mon churn mid-send)."""
         while not self._stopping:
+            if self._hang_until and time.monotonic() < self._hang_until:
+                # injected hang: a wedged daemon cannot re-boot either —
+                # the mark-down must stick until the hang lifts
+                await asyncio.sleep(0.2)
+                continue
             me = self.osdmap.osds.get(self.whoami)
             if me is not None and me.up and self._same_addr(me.addr):
                 return
@@ -403,30 +564,40 @@ class OSD(Dispatcher):
             await asyncio.sleep(2.0)
 
     async def stop(self) -> None:
+        if self._stop_event is not None:
+            # a stop is already running (or done): wait it out so the
+            # caller never proceeds while teardown is mid-flight
+            await self._stop_event.wait()
+            return
+        self._stop_event = asyncio.Event()
         self._stopping = True
-        bg = [t for t in (self._hb_task, self._scrub_task,
-                          self._reboot_task) if t is not None]
-        # background + detached-notify tasks too: anything left pending
-        # when the loop closes is destroyed (messenger leak's sibling)
-        bg += list(self._bg_tasks) + list(self._notify_tasks)
-        await reap_all(bg)
-        self._bg_tasks.clear()
-        self._notify_tasks.clear()
-        for pg in self.pgs.values():
-            pg._cancel_peering()
-            pg.backend.fail_inflight("osd stopping")
-        for waiting in self._waiting_for_active.values():
-            for _, _, _, trk in waiting:
-                trk.finish()
-        self._waiting_for_active.clear()
-        await self.op_queue.stop()
-        await self.finisher.stop()
-        if self.asok is not None:
-            self.asok.stop()
-        await self.mgr_client.stop()
-        await self.monc.close()
-        await self.messenger.shutdown()
-        self.store.umount()
+        try:
+            bg = [t for t in (self._hb_task, self._scrub_task,
+                              self._reboot_task) if t is not None]
+            # background + detached-notify tasks too: anything left
+            # pending when the loop closes is destroyed (messenger
+            # leak's sibling)
+            bg += list(self._bg_tasks) + list(self._notify_tasks)
+            await reap_all(bg)
+            self._bg_tasks.clear()
+            self._notify_tasks.clear()
+            for pg in self.pgs.values():
+                pg._cancel_peering()
+                pg.backend.fail_inflight("osd stopping")
+            for waiting in self._waiting_for_active.values():
+                for _, _, _, trk in waiting:
+                    trk.finish()
+            self._waiting_for_active.clear()
+            await self.op_queue.stop()
+            await self.finisher.stop()
+            if self.asok is not None:
+                self.asok.stop()
+            await self.mgr_client.stop()
+            await self.monc.close()
+            await self.messenger.shutdown()
+            self.store.umount()
+        finally:
+            self._stop_event.set()
 
     # -- osdmap plane --------------------------------------------------------
 
@@ -560,6 +731,27 @@ class OSD(Dispatcher):
         while True:
             await asyncio.sleep(self.config.get("osd_heartbeat_interval"))
             now = time.monotonic()
+            if self._hang_until:
+                if now < self._hang_until:
+                    continue    # injected hang: no pings, no reports
+                # hang lifted: the map pushes announcing our mark-down
+                # were swallowed (the mon thinks it delivered them) —
+                # re-request the map so the wrongly-marked-down re-boot
+                # path sees the mark-down and recovers. The liveness
+                # stamps also froze (ping replies were swallowed): left
+                # stale, the very next tick would report EVERY healthy
+                # peer failed — re-seed them instead
+                self._hang_until = 0.0
+                self._hb_last.clear()
+                self._hb_reported.clear()
+                dout("osd", 1, f"osd.{self.whoami} injected hang "
+                               f"lifted; re-requesting osdmap")
+                try:
+                    await self.monc.request_osdmap(0)
+                except Exception as e:
+                    dout("osd", 3, f"osd.{self.whoami} post-hang map "
+                                   f"request failed: "
+                                   f"{type(e).__name__} {e}")
             for peer in self._hb_peers():
                 if not self.osdmap.is_up(peer):
                     self._hb_last.pop(peer, None)
@@ -603,6 +795,12 @@ class OSD(Dispatcher):
             pg.drop_watchers_for_conn(conn)
 
     async def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        if self._hang_until and time.monotonic() < self._hang_until:
+            # injected hang: swallow everything (pings AND the map
+            # pushes the MonClient would otherwise consume after us in
+            # the chain) so peers see heartbeat silence, report us
+            # failed, and the mon marks us down
+            return True
         if isinstance(msg, MPing):
             # the reply must name the RESPONDER: the pinger keys its
             # liveness table by who answered, not by who asked

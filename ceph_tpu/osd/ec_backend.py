@@ -39,7 +39,9 @@ import time
 
 from ceph_tpu.crush.crush import CRUSH_NONE
 from ceph_tpu.ec import registry
+from ceph_tpu.ec.interface import ErasureCodeError
 from ceph_tpu.offload import get_service_or_none
+from ceph_tpu.qa import faultinject
 from ceph_tpu.msg.messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
                                    MOSDECSubOpWrite, MOSDECSubOpWriteReply)
 from ceph_tpu.objectstore.store import StoreError
@@ -98,6 +100,12 @@ class ECBackend(PGBackend):
         # observability: extent bytes served to sub-reads (tests assert
         # ranged reads move << object size)
         self.sub_read_bytes_served = 0
+        # repair-bandwidth accounting (the failure-storm bench's
+        # repair-bytes ratio): actual bytes fetched by recovery
+        # reconstruction gathers vs what a full-stripe gather (k whole
+        # chunks) would have moved for the same repairs
+        self.repair_bytes_fetched = 0
+        self.repair_bytes_full = 0
 
     # -- helpers -------------------------------------------------------------
 
@@ -602,6 +610,15 @@ class ECBackend(PGBackend):
             self.local_apply(oid, kind, sub["args"].encode("latin1"))
         else:
             raise StoreError("EINVAL", f"unknown ec sub-op {kind!r}")
+        if chunk and faultinject.armed():
+            # injected shard bit-rot AFTER the apply: the per-chunk crc
+            # attr now disagrees with the blob, exactly like silent
+            # media rot — the read/scrub crc gates must catch it
+            off = faultinject.maybe_bitrot(len(chunk))
+            if off is not None:
+                self.host.store.corrupt(
+                    self.coll(), self.ghobject(oid),
+                    sub.get("chunk_off", 0) + off)
 
     def _apply_extent(self, oid: str, sub: dict, chunk: bytes) -> None:
         """Apply a per-shard extent sub-write: splice the chunk extent
@@ -983,6 +1000,14 @@ class ECBackend(PGBackend):
         loc = self._verified_local_extent(
             p["oid"], p.get("chunk_off", 0), p.get("chunk_len", -1),
             prev=p.get("prev", False), snap=p.get("snap"))
+        if loc is not None and p.get("runs"):
+            # regenerating-code repair fetch: serve only the requested
+            # sub-chunk byte runs of each chunk (crc-verified above on
+            # the whole extent) — the d-helper fragment the CLAY plan
+            # reconstructs from, ~q x less data than the full chunk
+            sliced = self._slice_runs(loc[0], p["runs"])
+            loc = None if sliced is None \
+                else (sliced, loc[1], loc[2], loc[3])
         data = b""
         if loc is not None:
             data, shard, size, ver = loc
@@ -1030,6 +1055,183 @@ class ECBackend(PGBackend):
         self.pg.persist_meta()
         await self.execute_write(oid, "write_full", data, entry)
 
+    def _slice_runs(self, data: bytes,
+                    runs: list) -> bytes | None:
+        """Per-chunk sub-chunk byte runs of a whole-chunk shard blob:
+        for each chunk of `data`, concatenate the [off, off+len) runs.
+        None when the blob is not whole-chunk aligned or a run falls
+        outside the chunk (caller falls back to a full fetch)."""
+        c = self.sinfo.chunk_size
+        if not data or len(data) % c:
+            return None
+        out = bytearray()
+        for base in range(0, len(data), c):
+            for off, ln in runs:
+                if off < 0 or ln <= 0 or off + ln > c:
+                    return None
+                out += data[base + off:base + off + ln]
+        return bytes(out)
+
+    def _note_repair(self, fetched: int, full_equiv: int) -> None:
+        self.repair_bytes_fetched += fetched
+        self.repair_bytes_full += full_equiv
+        self.host.perf.inc("recovery_bytes_fetched", fetched)
+        self.host.perf.inc("recovery_bytes_full_equiv", full_equiv)
+
+    async def _maybe_repair_reconstruct(
+            self, oid: str, idx: int) -> tuple[bytes, dict] | None:
+        """Bandwidth-optimal single-shard reconstruction: when the
+        plugin exposes a sub-chunk repair plan (CLAY regenerating
+        codes), fetch only the plan's (offset, count) sub-chunk runs
+        from the d helpers — repair_per_chunk = sub_chunk_no/q bytes of
+        each helper chunk instead of k whole chunks — and rebuild the
+        lost position through the offload service's repair job.
+
+        Strictly an optimization with a conservative applicability
+        gate: every helper must answer with ONE uniform version, every
+        other live shard (the target included) is version-stat'ed in
+        the same round and must not hold anything NEWER (a partial
+        fan-out is the full gather's rollback business, not ours), and
+        any miss, mismatch, or timeout returns None so the caller runs
+        the existing full-stripe gather."""
+        if not self.host.config.get("osd_ec_repair_subchunks"):
+            return None
+        sub = self.ec_impl.get_sub_chunk_count()
+        c = self.sinfo.chunk_size
+        if sub <= 1 or c % sub or self.ec_impl.get_chunk_mapping():
+            return None
+        live = self._live_positions()
+        avail = set(live) - {idx}
+        try:
+            minimum = self.ec_impl.minimum_to_decode([idx], avail)
+        except ErasureCodeError:
+            return None
+        if set(minimum) - avail:
+            return None
+        runs = next(iter(minimum.values()))
+        per_chunk_subs = sum(cnt for _, cnt in runs)
+        if per_chunk_subs >= sub:
+            return None             # whole-chunk plan: nothing to save
+        ssz = c // sub
+        rpc = per_chunk_subs * ssz
+        byte_runs = [[off * ssz, cnt * ssz] for off, cnt in runs]
+
+        frags: dict[int, bytes] = {}
+        metas: dict[int, tuple] = {}    # helper shard -> (size, version)
+        others: list[tuple] = []        # non-helper shard versions
+        uattrs: dict = {}
+        waits: dict[asyncio.Future, tuple] = {}
+        pending: set = set()
+        ok = True
+        for shard, osd in sorted(live.items()):
+            helper = shard in minimum
+            if osd == self.host.whoami:
+                loc = self._verified_local_extent(oid, 0,
+                                                  -1 if helper else 0)
+                if loc is None:
+                    if helper:
+                        ok = False
+                        break
+                    continue
+                data, lshard, size, ver = loc
+                if helper:
+                    frag = self._slice_runs(data, byte_runs) \
+                        if lshard == shard else None
+                    if frag is None:
+                        ok = False
+                        break
+                    frags[shard] = frag
+                    metas[shard] = (size, tuple(ver))
+                    uattrs.update(
+                        {k[2:]: v.decode("latin1") for k, v in
+                         self._local_user_attrs(oid).items()})
+                else:
+                    others.append(tuple(ver))
+                continue
+            tid = self.new_tid()
+            fut = asyncio.get_running_loop().create_future()
+            self._read_waiters[tid] = fut
+            waits[fut] = (tid, shard, helper)
+            try:
+                await self.host.send_osd(osd, MOSDECSubOpRead(
+                    {"pgid": [self.pg.pgid.pool, self.pg.pgid.ps],
+                     "tid": tid, "from": self.host.whoami, "oid": oid,
+                     "chunk_off": 0,
+                     "chunk_len": -1 if helper else 0,
+                     "runs": byte_runs if helper else None}))
+                pending.add(fut)
+            except Exception:
+                # an unreachable shard — helper OR version-stat — makes
+                # the "no newer version anywhere" gate unverifiable:
+                # the full gather (which owns divergence rollback) must
+                # decide instead
+                fut.cancel()
+                ok = False
+                break
+        try:
+            deadline = asyncio.get_running_loop().time() \
+                + READ_TIMEOUT / 2
+            while ok and pending:
+                timeout = deadline - asyncio.get_running_loop().time()
+                if timeout <= 0:
+                    break
+                done, pending = await asyncio.wait(
+                    pending, timeout=timeout,
+                    return_when=asyncio.ALL_COMPLETED)
+                for fut in done:
+                    _tid, shard, helper = waits[fut]
+                    try:
+                        payload, data = fut.result()
+                    except Exception:
+                        ok = False      # cancelled mid-gather
+                        continue
+                    if helper:
+                        if not payload.get("found") or \
+                                payload.get("shard") != shard:
+                            ok = False
+                            continue
+                        frags[shard] = data
+                        metas[shard] = (payload["ec_size"], tuple(
+                            payload.get("version", (0, 0))))
+                        uattrs.update(payload.get("uattrs") or {})
+                    elif payload.get("found"):
+                        others.append(tuple(
+                            payload.get("version", (0, 0))))
+        finally:
+            for fut, (tid, _, _) in waits.items():
+                fut.cancel()
+                self._read_waiters.pop(tid, None)
+        if pending:
+            # an unanswered live shard — even a mere version stat —
+            # leaves the newer-version check unproven
+            ok = False
+        if not ok or set(frags) != set(minimum):
+            return None
+        vers = {v for _, v in metas.values()}
+        sizes = {s for s, _ in metas.values()}
+        lens = {len(b) for b in frags.values()}
+        if len(vers) != 1 or len(sizes) != 1 or len(lens) != 1:
+            return None
+        version = vers.pop()
+        if any(v > version for v in others):
+            return None     # newer partial state: full gather decides
+        blen = lens.pop()
+        if blen == 0 or blen % rpc:
+            return None
+        chunk = (await ec_util.decode_shards_async(
+            self.sinfo, self.ec_impl, frags, [idx],
+            service=get_service_or_none(), fragments=True))[idx]
+        fetched = blen * len(frags)
+        full_equiv = self.k * (blen // rpc) * c
+        self._note_repair(fetched, full_equiv)
+        attrs = self._chunk_attrs(idx, sizes.pop(), version,
+                                  self._csums(chunk))
+        for name, val in uattrs.items():
+            attrs["u:" + name] = val.encode("latin1")
+        dout("osd", 4, f"ec {oid}: sub-chunk repair of shard {idx} "
+                       f"fetched {fetched}B vs {full_equiv}B full-gather")
+        return chunk, attrs
+
     async def _reconstruct(self, oid: str, idx: int,
                            exclude: frozenset) -> tuple[bytes, dict] | None:
         """Chunk for position `idx` + its attrs, reconstructed from any k
@@ -1041,13 +1243,25 @@ class ECBackend(PGBackend):
         instead converged by a divergence rewrite (the caller's push is
         already done). Transient <k availability (EIO with no rollback
         possible) propagates so peering retries instead of recording a
-        deletion."""
+        deletion.
+
+        Regenerating-code fast path first: a sub-chunk repair plan
+        (CLAY) moves repair_per_chunk bytes from d helpers instead of k
+        whole chunks; any applicability doubt falls back here."""
+        if not exclude:
+            rec = await self._maybe_repair_reconstruct(oid, idx)
+            if rec is not None:
+                return rec
         got, ec_size, meta = await self._gather_chunks(
             oid, exclude_osds=exclude, allow_rollback=True)
         if meta["rolled_back"]:
             await self._rewrite_consistent(oid, got, ec_size,
                                            meta["version"])
             return None
+        blob = len(next(iter(got.values()))) if got else 0
+        if blob:
+            self._note_repair(sum(len(b) for b in got.values()),
+                              self.k * blob)
         if idx in got:
             chunk = got[idx]
         else:

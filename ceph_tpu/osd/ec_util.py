@@ -383,22 +383,37 @@ async def decode_concat_async(sinfo: StripeInfo, ec_impl,
 
 
 def _decode_shards_frame(sinfo: StripeInfo, ec_impl,
-                         to_decode: Mapping[int, bytes], need: list[int]):
+                         to_decode: Mapping[int, bytes], need: list[int],
+                         fragments: bool = False):
     """Shared repair-plan validation for decode_shards(): returns
     (arrays, helpers, plan_counts, sub, repair_per_chunk, n_chunks) —
     one copy, so plan-contract fixes (like the ADVICE-r2 homogeneity
-    guard) apply to the inline and offload paths alike."""
+    guard) apply to the inline and offload paths alike.
+
+    `fragments` declares that the buffers were FETCHED per the plugin's
+    sub-chunk repair plan (strided runs). Without it, whole-chunk
+    buffers that happen to satisfy a repair plan's preconditions (a
+    gather that topped up to >= d shards on a clay pool) must NOT be
+    sliced by that plan — contiguous chunk thirds are not the plan's
+    strided sub-chunk runs, and the mis-slice would silently decode
+    garbage (and inflate the output q-fold)."""
     arrays = {i: np.frombuffer(b, dtype=np.uint8)
               for i, b in to_decode.items()}
     if not arrays:
         raise ErasureCodeError("no chunks to decode")
+    sub = ec_impl.get_sub_chunk_count()
     minimum = ec_impl.minimum_to_decode(need, set(arrays))
+    if not fragments and any(
+            sum(cnt for _, cnt in runs) != sub
+            for runs in minimum.values()):
+        # sub-chunk plan over whole-chunk buffers: decode from the
+        # provided whole chunks instead
+        minimum = {i: [(0, sub)] for i in sorted(arrays)}
     missing_helpers = sorted(set(minimum) - set(arrays))
     if missing_helpers:
         raise ErasureCodeError(
             f"repair plan needs shards {missing_helpers} that were not "
             f"fetched (have {sorted(arrays)})")
-    sub = ec_impl.get_sub_chunk_count()
     subchunk_size = sinfo.chunk_size // sub
     # the repair plan must be homogeneous: every helper contributes the
     # same number of sub-chunks per chunk, or the fixed-stride slicing
@@ -426,21 +441,51 @@ def _decode_shards_frame(sinfo: StripeInfo, ec_impl,
 async def decode_shards_async(sinfo: StripeInfo, ec_impl,
                               to_decode: Mapping[int, bytes],
                               need: Iterable[int],
-                              service=None) -> dict[int, bytes]:
-    """decode_shards() with the whole-chunk batched repair dispatch
-    routed through the offload service. Sub-chunk (CLAY) and mapped
-    plugins keep the inline path — their repair plans don't stack into
-    the service's (n, k, C) job shape."""
+                              service=None,
+                              fragments: bool = False) -> dict[int, bytes]:
+    """decode_shards() with the repair dispatch routed through the
+    offload service. Whole-chunk plans on batch-capable plugins ride
+    the DecodeJob (n, k, C) shape; single-shard SUB-CHUNK plans (the
+    CLAY regenerating repair, fed by a runs-gather that fetched only
+    repair_per_chunk bytes per helper chunk — declared by
+    `fragments=True`) ride the service's repair job — coalesced per
+    erasure pattern and run off the event loop. Mapped plugins and
+    multi-shard sub-chunk plans keep the inline path."""
     need_l = sorted(set(need))
+    if (fragments and service is not None and len(need_l) == 1
+            and ec_impl.get_sub_chunk_count() > 1
+            and not ec_impl.get_chunk_mapping()):
+        arrays, helpers, plan_counts, sub, rpc, n_chunks = \
+            _decode_shards_frame(sinfo, ec_impl, to_decode, need_l,
+                                 fragments=True)
+        if n_chunks > 0 and rpc < sinfo.chunk_size:
+            with tracer.span("ec_recover") as sp:
+                if sp is not None:
+                    sp.set_tag("need", need_l)
+                    sp.set_tag("helpers", helpers)
+                    sp.set_tag("chunks", n_chunks)
+                    sp.set_tag("sub_chunks", sub)
+                    sp.set_tag("sub_chunks_fetched_per_chunk",
+                               next(iter(plan_counts.values())))
+                    sp.set_tag("offload", True)
+                frags = np.stack([arrays[h].reshape(n_chunks, rpc)
+                                  for h in helpers], axis=1)
+                out = np.asarray(await service.repair(
+                    ec_impl, tuple(helpers), tuple(need_l), frags,
+                    sinfo.chunk_size))
+                return {need_l[0]:
+                        np.ascontiguousarray(out).tobytes()}
     if not (service is not None
             and ec_impl.get_sub_chunk_count() == 1
             and not ec_impl.get_chunk_mapping()
             and callable(getattr(ec_impl, "decode_stripes", None))):
-        return decode_shards(sinfo, ec_impl, to_decode, need_l)
+        return decode_shards(sinfo, ec_impl, to_decode, need_l,
+                             fragments=fragments)
     arrays, helpers, _plan, _sub, _rpc, n_chunks = _decode_shards_frame(
         sinfo, ec_impl, to_decode, need_l)
     if n_chunks == 0:
-        return decode_shards(sinfo, ec_impl, to_decode, need_l)
+        return decode_shards(sinfo, ec_impl, to_decode, need_l,
+                             fragments=fragments)
     with tracer.span("ec_recover") as sp:
         if sp is not None:
             sp.set_tag("need", need_l)
@@ -458,18 +503,21 @@ async def decode_shards_async(sinfo: StripeInfo, ec_impl,
 
 
 def decode_shards(sinfo: StripeInfo, ec_impl, to_decode: Mapping[int, bytes],
-                  need: Iterable[int]) -> dict[int, bytes]:
+                  need: Iterable[int],
+                  fragments: bool = False) -> dict[int, bytes]:
     """Reconstruct whole shards (data or parity) — the per-shard
     ECUtil::decode variant (ECUtil.cc:61-131) used by shard recovery.
 
-    `to_decode` holds the shard buffers fetched per minimum_to_decode
-    (possibly sub-chunk fragments: each shard buffer contains
+    `to_decode` holds whole-chunk shard buffers, or — with
+    `fragments=True` — sub-chunk fragments fetched per
+    minimum_to_decode (each shard buffer contains
     repair_data_per_chunk bytes per chunk); `need` lists shard ids to
     rebuild. Returns full-size rebuilt shards.
     """
     need = sorted(set(need))
     arrays, helpers, plan_counts, sub, repair_per_chunk, n_chunks = \
-        _decode_shards_frame(sinfo, ec_impl, to_decode, need)
+        _decode_shards_frame(sinfo, ec_impl, to_decode, need,
+                             fragments=fragments)
 
     with tracer.span("ec_recover") as sp:
         if sp is not None:

@@ -634,6 +634,10 @@ class PGInstance:
             payload["snap"] = snap
         if ss_blob is not None:     # EC: replicate the SnapSet/snapdir
             payload["ss"] = ss_blob
+        if data:
+            # recovery-bandwidth observability: the failure-storm bench
+            # derives recovery MB/s from this counter's delta
+            self.host.perf.inc("recovery_bytes_pushed", len(data))
         await self.host.send_osd(peer, MOSDPGPush(payload, data))
 
     # -- peering message handlers (both roles) -------------------------------

@@ -1,9 +1,21 @@
-"""QA harness: model-based random-op consistency checking + thrashing.
+"""QA harness: model-based random-op consistency checking, thrashing,
+and deterministic fault injection.
 
 The reference's core correctness methodology (src/test/osd/RadosModel.h
 random-op model checker, qa/tasks/ceph_manager.py:338 kill_osd /
-:552 revive_osd thrashing) re-created for this stack.
+:552 revive_osd thrashing, the ms_inject_* message-fault conf surface)
+re-created for this stack.
+
+Lazy exports: the fault injector is consulted from the messenger hot
+path, so importing `ceph_tpu.qa.faultinject` must not drag the model
+checker (and through it the whole client stack) into every process.
 """
-from ceph_tpu.qa.rados_model import ModelRunner, Thrasher
 
 __all__ = ["ModelRunner", "Thrasher"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from ceph_tpu.qa import rados_model
+        return getattr(rados_model, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -45,6 +45,12 @@ class RadosClient(Dispatcher):
 
     OP_TIMEOUT = 15.0
     ATTEMPT_TIMEOUT = 5.0
+    # capped exponential backoff between resends (Objecter backoff
+    # semantics): the first retry is immediate — it usually lands on a
+    # freshly-elected primary after the map refresh — later ones slow
+    # down so a storm of failed ops cannot hammer a recovering cluster
+    BACKOFF_BASE = 0.05
+    BACKOFF_MAX = 2.0
 
     def __init__(self, mon_addrs: list[tuple[str, int]],
                  auth_key: bytes | None = None):
@@ -175,7 +181,11 @@ class RadosClient(Dispatcher):
         # committed is answered from the log instead of re-executing
         self._reqseq += 1
         reqid = [self._nonce, self._reqseq]
+        attempt = 0
         while time.monotonic() < deadline:
+            if attempt:
+                await self._op_backoff(attempt, deadline)
+            attempt += 1
             if pool_name not in self.osdmap.pool_names:
                 raise RadosError(-2, f"pool {pool_name!r} does not exist")
             pg = pgid if pgid is not None \
@@ -226,6 +236,18 @@ class RadosClient(Dispatcher):
                 raise RadosError(rc, p.get("error", "op failed"))
             return p, outdata
         raise TimeoutError(f"op on {oid!r} timed out ({last})")
+
+    async def _op_backoff(self, attempt: int, deadline: float) -> None:
+        """Sleep the capped exponential backoff before resend `attempt`
+        (per-op: every logical op starts back at the base). Bounded by
+        the op's own deadline so backoff can never extend it."""
+        if attempt < 2:
+            return          # first retry is immediate (stale-map case)
+        delay = min(self.BACKOFF_MAX,
+                    self.BACKOFF_BASE * (2 ** (attempt - 2)))
+        delay = min(delay, max(0.0, deadline - time.monotonic()))
+        if delay > 0:
+            await asyncio.sleep(delay)
 
     async def _refresh_map(self, deadline: float) -> None:
         self._map_changed.clear()

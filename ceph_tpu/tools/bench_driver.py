@@ -421,6 +421,315 @@ def stage_cluster_tpu() -> dict:
     return results
 
 
+# -- failure storm: degraded operation + bandwidth-optimal recovery -----------
+
+def stage_failure_storm() -> dict:
+    """The degraded-operation story a production store is judged on,
+    measured end to end on a live cluster (ROADMAP failure-storm item):
+
+    Phase A (storm): 11 OSDs, EC pool plugin=clay k=8 m=3 d=10
+    (regenerating code; min_size=k+1). Under sustained mixed client
+    load, m=3 OSDs die mid-window. Degraded reads must keep succeeding
+    bit-identically the whole time (writes drop below min_size and
+    stall — counted, not errors). The three revive with their stores;
+    the stage reports time-to-clean, recovery MB/s (from the
+    recovery_bytes_pushed counters), and client p99 during backfill.
+
+    Phase B (single-shard repair): one OSD dies, fresh objects are
+    written degraded, the OSD revives, and log-driven recovery rebuilds
+    its shards through the CLAY sub-chunk repair plan — the
+    repair-bytes ratio vs the full-stripe baseline (d/q helper
+    fragments vs k whole chunks: 10/3 vs 8 chunks, ~0.42) is THE
+    regenerating-code acceptance number, wired into the trend guard.
+    """
+    import asyncio
+
+    KS, MS, DS = 8, 3, 10
+    N_OSDS = KS + MS
+    results: dict = {}
+
+    async def wait_clean(osds, pool_name, timeout=90.0):
+        from ceph_tpu.crush.crush import CRUSH_NONE
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while True:
+            clean = True
+            for osd in osds:
+                for pg in osd.pgs.values():
+                    if pg.pool.name != pool_name:
+                        continue
+                    if len(pg.acting) != N_OSDS or \
+                            CRUSH_NONE in pg.acting:
+                        clean = False
+                    elif pg.is_primary():
+                        if pg.state != "active" or pg._pending_recovery:
+                            clean = False
+                    elif pg.state not in ("active", "replica"):
+                        clean = False
+            # every PG must be hosted: primaries cover all of pg_num
+            prim = {(pg.pgid.pool, pg.pgid.ps)
+                    for osd in osds for pg in osd.pgs.values()
+                    if pg.pool.name == pool_name and pg.is_primary()
+                    and pg.state == "active"}
+            if clean and len(prim) == 8:
+                return loop.time()
+            if loop.time() > deadline:
+                return None
+            await asyncio.sleep(0.25)
+
+    async def wait_down(osds, dead, timeout=30.0):
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while loop.time() < deadline:
+            maps = [o.osdmap for o in osds if o.whoami not in dead]
+            if maps and all(
+                    all(i in m.osds and not m.osds[i].up for i in dead)
+                    for m in maps):
+                return True
+            await asyncio.sleep(0.2)
+        return False
+
+    def pattern(oid: str, gen: int, size: int) -> bytes:
+        import hashlib
+        seed = hashlib.sha256(f"{oid}:{gen}".encode()).digest()
+        return (seed * (size // len(seed) + 1))[:size]
+
+    def repair_totals(osds):
+        fetched = full = 0
+        for osd in osds:
+            for pg in osd.pgs.values():
+                b = pg.backend
+                fetched += getattr(b, "repair_bytes_fetched", 0)
+                full += getattr(b, "repair_bytes_full", 0)
+        return fetched, full
+
+    def pushed_total(osds):
+        return sum(o.perf.dump().get("recovery_bytes_pushed", 0)
+                   for o in osds)
+
+    async def body():
+        from ceph_tpu.objectstore.memstore import MemStore
+        from ceph_tpu.osd.daemon import OSD
+        from ceph_tpu.tools.cluster_boot import ephemeral_cluster
+
+        stores: dict[int, MemStore] = {}
+
+        def store_factory(tmp, i):
+            stores[i] = MemStore(f"osd{i}")
+            return stores[i]
+
+        async with ephemeral_cluster(N_OSDS, prefix="bench-storm-",
+                                     store_factory=store_factory) \
+                as (client, osds, mon):
+            mon_addrs = list(mon.monmap.mons.values())
+            await client.command({
+                "prefix": "osd erasure-code-profile set",
+                "name": "stormprof",
+                "profile": {"plugin": "clay", "k": str(KS),
+                            "m": str(MS), "d": str(DS),
+                            "scalar_mds": "jerasure"}})
+            await client.pool_create("storm", pg_num=8,
+                                     pool_type="erasure",
+                                     erasure_code_profile="stormprof")
+            io = client.ioctx("storm")
+            pool = client.osdmap.get_pool("storm")
+            obj = pool.stripe_width          # one full stripe per object
+            results["failure_storm_object_bytes"] = obj
+
+            # seed: immutable read-verified set + mutable churn set
+            imm = {f"s{i:03d}": pattern(f"s{i:03d}", 0, obj)
+                   for i in range(24)}
+            for oid, data in imm.items():
+                await io.write_full(oid, data)
+            mut_gen = {f"w{i:02d}": 0 for i in range(8)}
+            for oid in mut_gen:
+                await io.write_full(oid, pattern(oid, 0, obj))
+
+            import random as _random
+            rng = _random.Random(42)
+            lat: list[tuple[float, float, str]] = []
+            stats = {"reads": 0, "writes": 0, "errors": 0, "stalls": 0,
+                     "read_stalls": 0, "degraded_reads": 0}
+            # oids with an outcome-unknown (timed-out) write: RADOS
+            # semantics let the abandoned op land later, so their final
+            # content is "any written generation", never garbage
+            uncertain: set = set()
+            stop_flag = [False]
+            window = {"t_kill": None, "t_revive": None}
+            loop = asyncio.get_running_loop()
+
+            async def reader():
+                oids = sorted(imm)
+                while not stop_flag[0]:
+                    oid = rng.choice(oids)
+                    t0 = loop.time()
+                    try:
+                        got = await io.read(oid)
+                    except Exception:
+                        # a slow/timed-out read is degraded
+                        # AVAILABILITY; only wrong bytes are a data
+                        # error
+                        stats["read_stalls"] += 1
+                        continue
+                    if got != imm[oid]:
+                        stats["errors"] += 1
+                        continue
+                    now = loop.time()
+                    lat.append((now, (now - t0) * 1e3, "read"))
+                    stats["reads"] += 1
+                    if window["t_kill"] is not None and \
+                            window["t_revive"] is None:
+                        stats["degraded_reads"] += 1
+                    await asyncio.sleep(0.01)
+
+            async def writer():
+                oids = sorted(mut_gen)
+                while not stop_flag[0]:
+                    oid = rng.choice(oids)
+                    gen = mut_gen[oid] + 1
+                    t0 = loop.time()
+                    try:
+                        await client.submit(
+                            "storm", oid,
+                            [{"op": "write_full", "oid": oid}],
+                            pattern(oid, gen, obj), timeout=4.0)
+                        mut_gen[oid] = gen
+                        now = loop.time()
+                        lat.append((now, (now - t0) * 1e3, "write"))
+                        stats["writes"] += 1
+                    except Exception:
+                        # below min_size the pool rejects writes: a
+                        # stall with UNKNOWN outcome, not a data error
+                        stats["stalls"] += 1
+                        uncertain.add(oid)
+                    await asyncio.sleep(0.02)
+
+            load = [loop.create_task(reader()) for _ in range(3)] + \
+                   [loop.create_task(writer()) for _ in range(2)]
+            try:
+                await asyncio.sleep(2.0)            # baseline window
+                dead = [N_OSDS - 3, N_OSDS - 2, N_OSDS - 1]
+                window["t_kill"] = loop.time()
+                for i in dead:
+                    await osds[i].stop()
+                down_ok = await wait_down(osds, dead)
+                results["failure_storm_marked_down"] = down_ok
+                await asyncio.sleep(4.0)            # degraded window
+                pushed0 = pushed_total(
+                    [o for o in osds if o.whoami not in dead])
+                window["t_revive"] = loop.time()
+                for i in dead:
+                    osd = OSD(i, mon_addrs, store=stores[i])
+                    await osd.start()
+                    osds[i] = osd
+                t_clean = await wait_clean(osds, "storm")
+                t_rec = (t_clean - window["t_revive"]) if t_clean \
+                    else None
+                await asyncio.sleep(0.5)
+            finally:
+                stop_flag[0] = True
+                for t in load:
+                    t.cancel()
+                await asyncio.gather(*load, return_exceptions=True)
+
+            pushed = pushed_total(osds) - pushed0
+            results["failure_storm_reached_clean"] = t_rec is not None
+            if t_rec is not None:
+                # only recorded when clean was reached: the trend guard
+                # skips missing keys, and a sentinel like -1.0 would
+                # read as an improvement on a COST key exactly when the
+                # cluster stopped converging
+                results["failure_storm_time_to_clean_s"] = round(
+                    t_rec, 2)
+            # phase A recovery volume is whatever client writes landed
+            # before the kill (informational: writes stall below
+            # min_size, so the storm itself adds little to repair);
+            # the guarded recovery-rate metric comes from phase B's
+            # deterministic degraded-write workload
+            results["failure_storm_storm_recovery_bytes"] = pushed
+            backfill = [ms for t, ms, _ in lat
+                        if window["t_revive"] is not None
+                        and t >= window["t_revive"]]
+            backfill.sort()
+            results["failure_storm_backfill_p99_ms"] = round(
+                backfill[int(0.99 * (len(backfill) - 1))], 1) \
+                if backfill else 0.0
+            degraded = [ms for t, ms, k in lat
+                        if k == "read" and window["t_kill"] is not None
+                        and window["t_kill"] <= t <
+                        (window["t_revive"] or 1e18)]
+            degraded.sort()
+            results["failure_storm_degraded_p99_ms"] = round(
+                degraded[int(0.99 * (len(degraded) - 1))], 1) \
+                if degraded else 0.0
+            results["failure_storm_degraded_reads"] = \
+                stats["degraded_reads"]
+            results["failure_storm_write_stalls"] = stats["stalls"]
+
+            # final verification: every object byte-identical to A
+            # written generation — an uncertain (timed-out) write may
+            # have landed late, but the bytes must never be garbage
+            errors = stats["errors"]
+            for oid, data in imm.items():
+                if await io.read(oid) != data:
+                    errors += 1
+            for oid, gen in mut_gen.items():
+                got = await io.read(oid)
+                accept = range(gen + 3) if oid in uncertain \
+                    else (gen, gen + 1)
+                if not any(got == pattern(oid, g, obj) for g in accept):
+                    errors += 1
+            results["failure_storm_client_errors"] = errors
+            results["failure_storm_read_stalls"] = stats["read_stalls"]
+            log(f"failure_storm: clean={t_rec and round(t_rec, 1)}s "
+                f"degraded_reads={stats['degraded_reads']} "
+                f"errors={errors}")
+
+            # -- phase B: single-shard repair-bytes ratio + recovery
+            # rate over a DETERMINISTIC degraded-write workload.
+            # Baselines exclude osd.0: it is about to be REPLACED by a
+            # fresh instance whose counters start at zero, so including
+            # its phase-A accumulation in f0 would subtract bytes that
+            # no longer exist in f1 (skewing the ratio, possibly
+            # negative) ------------------------------------------------
+            f0, full0 = repair_totals(osds[1:])
+            window["t_kill"] = window["t_revive"] = None
+            await osds[0].stop()
+            await wait_down(osds, [0])
+            for i in range(16):
+                oid = f"b{i:03d}"
+                await io.write_full(oid, pattern(oid, 0, obj))
+            pushed_b0 = pushed_total(osds[1:])
+            osd = OSD(0, mon_addrs, store=stores[0])
+            await osd.start()
+            osds[0] = osd
+            t_revive_b = loop.time()
+            t_clean_b = await wait_clean(osds, "storm")
+            pushed_b = pushed_total(osds) - pushed_b0
+            rec_s = (t_clean_b - t_revive_b) if t_clean_b else None
+            results["failure_storm_recovery_mb_s"] = round(
+                pushed_b / rec_s / 1e6, 3) if rec_s else 0.0
+            results["failure_storm_recovery_bytes"] = pushed_b
+            f1, full1 = repair_totals(osds)
+            fetched_b, full_b = f1 - f0, full1 - full0
+            ratio = round(fetched_b / full_b, 4) if full_b else 1.0
+            results["failure_storm_repair_ratio"] = ratio
+            results["failure_storm_repair_fetched_mb"] = round(
+                fetched_b / 1e6, 3)
+            results["failure_storm_repair_full_equiv_mb"] = round(
+                full_b / 1e6, 3)
+            results["failure_storm_repair_clean"] = t_clean_b is not None
+            for i in range(16):
+                oid = f"b{i:03d}"
+                if await io.read(oid) != pattern(oid, 0, obj):
+                    results["failure_storm_client_errors"] += 1
+            log(f"failure_storm: repair ratio {ratio} "
+                f"({fetched_b} of {full_b} full-gather bytes)")
+
+    asyncio.run(asyncio.wait_for(body(), 280))
+    return results
+
+
 # -- attribution: the "where the 450x goes" waterfall -------------------------
 
 #: waterfall buckets in pipeline order; "other" is the residual the
@@ -610,12 +919,15 @@ def stage_attribution() -> dict:
 # committed BENCH_r*.json and embeds the verdict in the output line, so
 # a silent slide becomes a loud `regression_pct` the round it happens.
 
-TREND_KEYS = ("tpu_encode", "tpu_decode")
-#: attribution-profiler keys where UP is the regression direction:
-#: more copied bytes per written byte, or a busier event loop, is a
-#: data-path slide even when the GB/s numbers hold. Guarded once two
-#: rounds carry them (older rounds simply lack the keys).
-TREND_KEYS_COST = ("copy_amplification", "loop_busy_fraction")
+TREND_KEYS = ("tpu_encode", "tpu_decode", "failure_storm_recovery_mb_s")
+#: keys where UP is the regression direction: more copied bytes per
+#: written byte, a busier event loop, a slower recovery to clean, or a
+#: repair fetch creeping back toward the full-stripe baseline is a
+#: slide even when the GB/s numbers hold. Guarded once two rounds
+#: carry them (older rounds simply lack the keys).
+TREND_KEYS_COST = ("copy_amplification", "loop_busy_fraction",
+                   "failure_storm_time_to_clean_s",
+                   "failure_storm_repair_ratio")
 TREND_THRESHOLD_PCT = 10.0
 
 
@@ -698,13 +1010,14 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--stage", choices=["cpu", "probe", "device",
                                        "cluster", "cluster_tpu",
-                                       "attribution"],
+                                       "attribution", "failure_storm"],
                    required=True)
     args = p.parse_args()
     out = {"cpu": stage_cpu, "probe": stage_probe,
            "device": stage_device, "cluster": stage_cluster,
            "cluster_tpu": stage_cluster_tpu,
-           "attribution": stage_attribution}[args.stage]()
+           "attribution": stage_attribution,
+           "failure_storm": stage_failure_storm}[args.stage]()
     print(json.dumps(out), flush=True)
     return 0
 

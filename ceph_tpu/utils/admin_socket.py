@@ -60,6 +60,16 @@ class AdminSocket:
         self.register_command("dump_recent",
                               lambda req: get_logger().ring.entries(),
                               "recent log events")
+        from ceph_tpu.utils import crash
+        self.register_command(
+            "crash ls",
+            lambda req: crash.ls(bool(req.get("all", False))),
+            "crash records (all=true includes archived)")
+        self.register_command(
+            "crash archive",
+            lambda req: {"archived": crash.archive(req.get("id"))},
+            "acknowledge crash records (id=... for one, else all): "
+            "they leave the RECENT_CRASH health surface")
         self.register_command("trace dump",
                               lambda req: tracer.dump(req.get("trace_id")),
                               "collected op trace spans grouped by trace")

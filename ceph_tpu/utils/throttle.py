@@ -1,15 +1,66 @@
-"""Throttle + HeartbeatMap: backpressure and stuck-thread detection.
+"""Throttle + HeartbeatMap + AdjustableSemaphore: backpressure and
+stuck-thread detection.
 
 Re-creations of the reference's `Throttle` (src/common/Throttle.{h,cc}:
 blocking counted-resource budget used on every IO path) and
 `HeartbeatMap` (src/common/HeartbeatMap.{h,cc}: every worker thread
 checks in with a grace deadline; `is_healthy` flags stuck threads and a
-suicide grace escalates to process abort).
+suicide grace escalates to process abort). `AdjustableSemaphore` is the
+AsyncReserver analog's slot pool, resizable live so reservation-backed
+knobs (osd_max_recovery_in_flight) can be retuned mid-storm.
 """
 from __future__ import annotations
 
+import asyncio
 import threading
 import time
+
+
+class AdjustableSemaphore(asyncio.Semaphore):
+    """asyncio.Semaphore whose slot count can be resized while held.
+
+    Growing releases the extra slots immediately (waiters wake);
+    shrinking takes free slots now and absorbs the rest as current
+    holders release — in-flight work is never cancelled, the pool just
+    refills to the smaller limit (the reference's AsyncReserver adjusts
+    max_allowed the same way). Implemented as a release-absorption debt
+    rather than driving `_value` negative: 3.10.9+/3.12 Semaphore's
+    acquire() fast-paths on `locked()` (`_value == 0 or waiters`), so a
+    negative `_value` would pass every acquire and DISABLE the throttle
+    exactly when a mid-storm shrink needs it. Must be resized from the
+    owning event loop's thread.
+    """
+
+    def __init__(self, value: int):
+        super().__init__(value)
+        self._limit = value
+        self._debt = 0      # releases to absorb instead of freeing
+
+    @property
+    def limit(self) -> int:
+        return self._limit
+
+    def resize(self, new_limit: int) -> None:
+        new_limit = max(1, int(new_limit))
+        delta = new_limit - self._limit
+        self._limit = new_limit
+        if delta > 0:
+            # pay down any absorption debt first; free the remainder
+            pay = min(self._debt, delta)
+            self._debt -= pay
+            for _ in range(delta - pay):
+                self.release()
+        elif delta < 0:
+            shrink = -delta
+            take_now = min(self._value, shrink)
+            self._value -= take_now
+            self._debt += shrink - take_now
+
+    def release(self) -> None:
+        if self._debt > 0:
+            self._debt -= 1     # absorbed: the pool shrank past this slot
+            return
+        super().release()
 
 
 class Throttle:

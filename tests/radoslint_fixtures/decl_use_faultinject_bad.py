@@ -1,0 +1,10 @@
+"""Known-positive decl-use: fault-injection knobs declared the way a
+lazy port would — as bare Options nobody reads and with no dynamic
+observer family — so they rot as dead knobs the lint must flag."""
+
+
+def declare(config, Option):
+    config.declare(Option("fault_inject_dead_p", "float", 0.0,
+                          "probability nobody consults"))
+    config.declare(Option("fault_inject_dead_ms", "float", 10.0,
+                          "delay nobody applies"))

@@ -1,0 +1,28 @@
+"""Known-negative decl-use: fault-injection knobs declared like
+qa/faultinject.py really declares them — an option family applied
+dynamically through an observer that slices the shared prefix — which
+the lint's prefix-const heuristic must honor as live use."""
+
+_DEFAULTS = {"drop_p": 0.0, "delay_ms": 10.0}
+
+
+def OPTIONS(Option):
+    return [Option("fault_inject_drop_p", "float", _DEFAULTS["drop_p"],
+                   "applied via the observer below"),
+            Option("fault_inject_delay_ms", "float",
+                   _DEFAULTS["delay_ms"], "applied via the observer")]
+
+
+def register_config(config, Option, injector):
+    names = []
+    for opt in OPTIONS(Option):
+        names.append(opt.name)
+        config.declare(opt)
+
+    def _on_change(name, value):
+        key = name[len("fault_inject_"):]
+        if key in _DEFAULTS:
+            _DEFAULTS[key] = value
+        setattr(injector, key, value)
+
+    config.add_observer(tuple(names), _on_change)

@@ -54,6 +54,97 @@ def test_retried_append_applies_once(tmp_path, pool):
     run(body())
 
 
+@pytest.mark.parametrize("pool", ["replicated", "erasure"])
+def test_injected_reply_drop_resend_dedups(tmp_path, pool):
+    """Injected-drop replay through the REAL client resend machinery:
+    the fault injector eats the MOSDOpReply, the client times the
+    attempt out and resends with the same reqid, and the pglog dup-op
+    table answers the retry without re-executing — the append applies
+    exactly once."""
+    from ceph_tpu.qa import faultinject
+
+    async def body():
+        if pool == "erasure":
+            c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3)
+        else:
+            c = ClusterHarness(tmp_path)
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=1, size=3)
+            io = cl.ioctx("rbd")
+        try:
+            await io.write_full("o", b"base")
+            faultinject.reset(seed=1)
+            faultinject.set_enabled(True)
+            try:
+                faultinject.arm_oneshot(entity="client",
+                                        msg_type="MOSDOpReply",
+                                        action="drop", count=1)
+                p, _ = await cl.submit(
+                    "rbd" if pool == "replicated" else "ecpool", "o",
+                    [{"op": "append", "oid": "o"}], b"+tail",
+                    attempt_timeout=0.5)
+            finally:
+                faultinject.set_enabled(False)
+                faultinject.reset()
+            # the retry was answered from the dup index, not re-executed
+            assert p["results"][0]["out"].get("dup"), p
+            assert await io.read("o") == b"base+tail"
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_injected_drop_replay_races_primary_mark_down(tmp_path):
+    """The failover race the satellite names: the reply is dropped,
+    the PRIMARY dies before the retry lands, and the NEW primary must
+    still recognize the reqid from the replicated log — the client's
+    op survives the whole storm applied exactly once."""
+    from ceph_tpu.qa import faultinject
+
+    async def body():
+        c = ClusterHarness(tmp_path)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=1, size=3)
+            io = cl.ioctx("rbd")
+            await io.write_full("o", b"base")
+            pg = _primary_pg(c, "replicated")
+            old_primary = pg.host.whoami
+            faultinject.reset(seed=2)
+            faultinject.set_enabled(True)
+            import asyncio
+
+            async def kill_after_first_drop():
+                # wait until the injector ate the reply, then kill the
+                # primary so the retry must land on its successor
+                deadline = asyncio.get_running_loop().time() + 10
+                while not faultinject.get_injector().log:
+                    assert asyncio.get_running_loop().time() < deadline
+                    await asyncio.sleep(0.02)
+                await c.kill_osd(old_primary)
+
+            try:
+                faultinject.arm_oneshot(entity="client",
+                                        msg_type="MOSDOpReply",
+                                        action="drop", count=1)
+                killer = asyncio.get_running_loop().create_task(
+                    kill_after_first_drop())
+                p, _ = await cl.submit(
+                    "rbd", "o", [{"op": "append", "oid": "o"}],
+                    b"+tail", timeout=30.0, attempt_timeout=0.5)
+                await killer
+            finally:
+                faultinject.set_enabled(False)
+                faultinject.reset()
+            assert p["results"][0]["out"].get("dup"), p
+            assert await io.read("o") == b"base+tail"
+        finally:
+            await c.stop()
+    run(body())
+
+
 def test_dup_index_survives_failover(tmp_path):
     """The reqid index rides the replicated log entries, so a NEW
     primary after failover still recognizes the retry."""

@@ -38,6 +38,8 @@ def lint(path, rules):
     ("cancellation-swallow", "cancellation_swallow_pos.py", 2,
      "cancellation_swallow_neg.py"),
     ("decl-use", "decl_use_bad.py", 5, "decl_use_good.py"),
+    ("decl-use", "decl_use_faultinject_bad.py", 2,
+     "decl_use_faultinject_good.py"),
     ("report-export-consistency", "report_export_bad.py", 1,
      "report_export_good.py"),
 ])
