@@ -86,13 +86,22 @@ def _run_stage_child(stage: str, env: dict, timeout: float) -> dict:
         stderr = e.stderr
         if isinstance(stderr, bytes):
             stderr = stderr.decode(errors="replace")
+        # a wedged stage is EXACTLY where leaked tasks show up: count
+        # them here too, or tail_clean would pass in the worst case
         return {"status": f"timeout after {timeout:.0f}s",
                 "elapsed_s": round(time.monotonic() - t0, 1),
+                "destroyed_tasks": (stderr or "").count(
+                    "Task was destroyed but it is pending"),
                 "stderr_tail": (stderr or "")[-800:]}
     except OSError as e:
         return {"status": f"launch failed: {e}",
                 "elapsed_s": round(time.monotonic() - t0, 1)}
     sys.stderr.write(proc.stderr)
+    # bench-tail cleanliness gate: a stage that destroys pending
+    # event-loop tasks ("Task was destroyed but it is pending!", the
+    # BENCH_r05 _dispatch_loop spam) is recorded per stage and rolled
+    # into the top-level `tail_clean` verdict
+    destroyed = proc.stderr.count("Task was destroyed but it is pending")
     for candidate in reversed(proc.stdout.strip().splitlines()):
         candidate = candidate.strip()
         if candidate.startswith("{"):
@@ -102,9 +111,11 @@ def _run_stage_child(stage: str, env: dict, timeout: float) -> dict:
                 break
             data["status"] = "ok"
             data["elapsed_s"] = round(time.monotonic() - t0, 1)
+            data["destroyed_tasks"] = destroyed
             return data
     return {"status": f"no JSON from child (rc={proc.returncode})",
             "elapsed_s": round(time.monotonic() - t0, 1),
+            "destroyed_tasks": destroyed,
             "stderr_tail": proc.stderr[-800:]}
 
 
@@ -210,10 +221,19 @@ def main() -> int:
         "detail": detail,
         "stages": {name: {k: s.get(k) for k in
                           ("status", "elapsed_s", "platform", "backend_init_s",
-                           "stderr_tail")
+                           "destroyed_tasks", "stderr_tail")
                           if k in s}
                    for name, s in stages.items()},
+        # no stage may leak pending event-loop tasks at teardown — the
+        # assertion form of the BENCH_r05 "Task was destroyed" tail fix
+        "tail_clean": all(s.get("destroyed_tasks", 0) == 0
+                          for s in stages.values()),
     }
+    if not out["tail_clean"]:
+        leaky = {n: s["destroyed_tasks"] for n, s in stages.items()
+                 if s.get("destroyed_tasks")}
+        sys.stderr.write(f"bench tail NOT clean: destroyed pending "
+                         f"tasks per stage: {leaky}\n")
     if not tpu_live:
         out["error"] = ("tpu backend did not come up inside the "
                         f"{DEVICE_TIMEOUT}s long-warm device child; device "

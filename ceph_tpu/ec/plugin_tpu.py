@@ -49,6 +49,20 @@ DEFAULT_K = 8
 DEFAULT_M = 3
 
 
+def _device_of(arr) -> str:
+    """`platform:id` of a committed single-device array ("sharded" for
+    mesh-placed inputs) — the span label the per-device utilization
+    dashboards join against."""
+    try:
+        ds = arr.devices()
+        if len(ds) != 1:
+            return "sharded"
+        d = next(iter(ds))
+        return f"{d.platform}:{d.id}"
+    except Exception:
+        return "unknown"
+
+
 def _profiled_roundtrip(kernel, host_batch, timings: list) -> np.ndarray:
     """One serialized H2D -> kernel -> D2H round trip, accumulating the
     three stage durations into `timings` ([h2d_s, kernel_s, d2h_s]).
@@ -124,6 +138,10 @@ class ErasureCodeTpu(ErasureCodeJerasure):
                 sp.set_tag("bytes", int(data.size))
                 sp.set_tag("k", self.k)
                 sp.set_tag("m", self.m)
+                if device_resident:
+                    # which mesh slot this batch landed on (the offload
+                    # service's device-affine routing made the choice)
+                    sp.set_tag("device", _device_of(data))
             if device_resident:
                 return self._encoder.apply_batch_device(data)
             return self._encode_host_pipelined(
@@ -181,6 +199,8 @@ class ErasureCodeTpu(ErasureCodeJerasure):
                 sp.set_tag("batch", int(chunks.shape[0]))
                 sp.set_tag("bytes", int(chunks.size))
                 sp.set_tag("want", list(want_ids))
+                if device_resident:
+                    sp.set_tag("device", _device_of(chunks))
             if device_resident:
                 return codec.apply_batch_device(chunks)
             chunks = np.ascontiguousarray(chunks, dtype=np.uint8)

@@ -75,9 +75,20 @@ def crc32c(data: bytes | np.ndarray, crc: int = 0xFFFFFFFF) -> int:
                                 ctypes.c_char_p, ctypes.c_size_t)(
             ctypes.cast(lib.crc32c, ctypes.c_void_p).value)
         _crc_fast = fast
-    if isinstance(data, (bytes, bytearray, memoryview)):
-        b = bytes(data) if not isinstance(data, bytes) else data
-        return int(_crc_fast(crc, b, len(b)))
+    if isinstance(data, bytes):
+        return int(_crc_fast(crc, data, len(data)))
+    if isinstance(data, (bytearray, memoryview)):
+        # zero-copy: view the buffer instead of materializing bytes —
+        # shard replies now arrive as memoryviews (ec_util zero-copy
+        # assemble) and a bytes() round trip here would give the copy
+        # right back. Strided views (which np.frombuffer rejects) keep
+        # the old materializing contract.
+        if isinstance(data, memoryview) and not data.c_contiguous:
+            b = bytes(data)
+            return int(_crc_fast(crc, b, len(b)))
+        arr = np.frombuffer(data, dtype=np.uint8)
+        return int(native.load().crc32c(ctypes.c_uint32(crc), _ptr(arr),
+                                        arr.size))
     arr = np.ascontiguousarray(data, dtype=np.uint8).reshape(-1)
     return int(native.load().crc32c(ctypes.c_uint32(crc), _ptr(arr),
                                     arr.size))

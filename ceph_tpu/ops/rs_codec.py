@@ -111,6 +111,29 @@ class MatrixCodec:
         self.r, self.k = M.shape
         B = gf256.matrix_to_bitmatrix(M)
         self._B = jnp.asarray(B.astype(np.int8))
+        # per-device pinned copies for the mesh fan-out: data committed
+        # to chip d must meet a bitmatrix committed to d, or every
+        # dispatch re-transfers the (uncommitted) matrix over the link
+        self._B_dev: dict = {}
+
+    def _bitmatrix_for(self, data) -> jax.Array:
+        """The bitmatrix pinned to `data`'s device (single-device
+        committed arrays); the default-device copy otherwise (host
+        input, or mesh-sharded arrays whose placement jax resolves)."""
+        devices = getattr(data, "devices", None)
+        if devices is None:
+            return self._B
+        try:
+            ds = devices()
+        except Exception:
+            return self._B
+        if len(ds) != 1:
+            return self._B
+        dev = next(iter(ds))
+        pinned = self._B_dev.get(dev)
+        if pinned is None:
+            pinned = self._B_dev[dev] = jax.device_put(self._B, dev)
+        return pinned
 
     @classmethod
     def get(cls, M: np.ndarray) -> "MatrixCodec":
@@ -126,7 +149,8 @@ class MatrixCodec:
 
     def apply_device(self, data: jax.Array) -> jax.Array:
         """data (k, N) uint8 already on device, N already bucket-aligned."""
-        return _apply_bitmatrix_jit(self._B, data, self.r, self.k)
+        return _apply_bitmatrix_jit(self._bitmatrix_for(data), data,
+                                    self.r, self.k)
 
     def apply_batch_device(self, data: jax.Array) -> jax.Array:
         """data (batch, k, N) uint8 on device -> (batch, r, N).
@@ -138,9 +162,10 @@ class MatrixCodec:
         """
         b, _, n = data.shape
         bb, nb = _bucket_batch(b), _bucket(n)
+        B_dev = self._bitmatrix_for(data)
         if (bb, nb) != (b, n):
             data = jnp.pad(data, ((0, bb - b), (0, 0), (0, nb - n)))
-        out = _apply_bitmatrix_batched_jit(self._B, data, self.r, self.k)
+        out = _apply_bitmatrix_batched_jit(B_dev, data, self.r, self.k)
         if (bb, nb) != (b, n):
             out = out[:b, :, :n]
         return out

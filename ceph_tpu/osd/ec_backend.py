@@ -117,7 +117,11 @@ class ECBackend(PGBackend):
 
     def _pad(self, data: bytes) -> bytes:
         w = self.sinfo.stripe_width
-        return data + b"\x00" * ((-len(data)) % w)
+        pad = (-len(data)) % w
+        # already aligned (every full-stripe client write): hand the
+        # buffer through untouched — the `data + b""` form copied the
+        # whole payload on the encode hot path
+        return data if not pad else data + b"\x00" * pad
 
     def _offload_svc(self):
         """The offload service, for DEVICE-batched plugins only: the
@@ -443,7 +447,10 @@ class ECBackend(PGBackend):
         if tail < len(region):
             region[tail:] = b"\x00" * (len(region) - tail)
 
-        shards = await self._encode(bytes(region))
+        # the bufferlist region goes to the codec as-is (np.frombuffer
+        # views a bytearray zero-copy); the old bytes(region) paid a
+        # full extra copy per RMW merge
+        shards = await self._encode(region)
         csums = await self._csums_shards(shards)
         new_n = -(-new_size // w)
         payloads = {}

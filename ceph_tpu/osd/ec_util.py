@@ -143,18 +143,27 @@ def _encode_frame(sinfo: StripeInfo, ec_impl, data, want):
 
 
 def _encode_assemble(stripes: np.ndarray, parity: np.ndarray, k: int,
-                     want, sp=None) -> dict[int, bytes]:
-    # shard-major contiguous copies first: .tobytes() on a strided
-    # view falls off numpy's memcpy path (~30x slower — profiled on
-    # the OSD write path)
+                     want, sp=None) -> dict[int, memoryview]:
+    """Shard planes -> per-shard reply buffers, ONE copy per byte.
+
+    The old path paid two: a full shard-major transpose
+    materialization of every shard, then .tobytes() per wanted shard
+    (bytes is immutable, so any bytes reply costs a second copy and
+    the unwanted shards were materialized for nothing). Here each
+    WANTED plane is written straight into a bytearray through a numpy
+    view and handed downstream as a memoryview — message frames,
+    object-store writes and crc all take buffer objects, so no further
+    copy happens until the wire."""
     t0 = time.perf_counter()
-    dm = np.ascontiguousarray(stripes.transpose(1, 0, 2))      # (k,S,C)
-    pm = np.ascontiguousarray(parity.transpose(1, 0, 2))       # (m,S,C)
-    out = {i: (dm[i] if i < k else pm[i - k]).tobytes()
-           for i in sorted(want)}
-    # two real copies per shard byte: the transpose materialization and
-    # the per-shard tobytes() — the D2H->reply half of the copy ledger
-    nbytes = dm.nbytes + pm.nbytes + sum(len(b) for b in out.values())
+    S, _, C = stripes.shape
+    out: dict[int, memoryview] = {}
+    nbytes = 0
+    for i in sorted(want):
+        src = stripes[:, i, :] if i < k else parity[:, i - k, :]
+        buf = bytearray(S * C)
+        np.copyto(np.frombuffer(buf, dtype=np.uint8).reshape(S, C), src)
+        out[i] = memoryview(buf)
+        nbytes += S * C
     dt = time.perf_counter() - t0
     copytrack.copied("reply_assemble", nbytes, dt)
     if sp is not None:

@@ -148,6 +148,44 @@ def sharded_pipeline_step_fn(mesh: Mesh, k: int, m: int,
     return step
 
 
+def shard_batch(mesh: Mesh, arr: np.ndarray):
+    """Pad a (B, k, C) host batch to the mesh's 'stripe' extent and place
+    it stripe-sharded; returns (device_array, original_B). Shared by the
+    storage impl below and the offload service's oversized-batch path."""
+    se = mesh.shape["stripe"]
+    n = arr.shape[0]
+    pad = (-n) % se
+    if pad:
+        arr = np.concatenate(
+            [arr, np.zeros((pad,) + arr.shape[1:], np.uint8)], axis=0)
+    dev = jax.device_put(
+        jnp.asarray(arr), NamedSharding(mesh, P("stripe", None, None)))
+    return dev, n
+
+
+def sharded_apply_fn(mesh: Mesh, M: np.ndarray):
+    """numpy->numpy sharded GF(2^8) matrix apply over `mesh`: returns
+    fn((B, k, C) uint8) -> (B, r, C) uint8 for the (r, k) matrix `M`.
+
+    This is the dispatch shape the offload service fans oversized
+    batches through: the stripe batch is data-parallel over 'stripe',
+    the output rows tensor-parallel over 'shard' — encode passes the
+    coding matrix, reconstruction passes a recovery matrix (the same
+    kernel either way, like sharded_encode_fn). Bit-identical to the
+    single-device codec: same field, same matrices, exact arithmetic."""
+    M = np.ascontiguousarray(M, dtype=np.uint8)
+    r, k = M.shape
+    enc = sharded_encode_fn(mesh, k, r, M)
+
+    def apply(batch: np.ndarray) -> np.ndarray:
+        arr = np.ascontiguousarray(np.asarray(batch), dtype=np.uint8)
+        dev, n = shard_batch(mesh, arr)
+        out, _ = enc(dev)
+        return np.asarray(out)[:n]
+
+    return apply
+
+
 def mesh_storage_impl(mesh: Mesh, k: int, m: int,
                       technique: str = "reed_sol_van"):
     """An ErasureCodeInterface impl whose batched stripe APIs run sharded
@@ -168,17 +206,7 @@ def mesh_storage_impl(mesh: Mesh, k: int, m: int,
         _enc = None
 
         def _shard_batch(self, arr: np.ndarray):
-            se = self._mesh.shape["stripe"]
-            n = arr.shape[0]
-            pad = (-n) % se
-            if pad:
-                arr = np.concatenate(
-                    [arr, np.zeros((pad,) + arr.shape[1:], np.uint8)],
-                    axis=0)
-            dev = jax.device_put(
-                jnp.asarray(arr),
-                NamedSharding(self._mesh, P("stripe", None, None)))
-            return dev, n
+            return shard_batch(self._mesh, arr)
 
         def encode_stripes(self, data):
             if self._enc is None:

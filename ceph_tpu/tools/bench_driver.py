@@ -186,6 +186,14 @@ def stage_device() -> dict:
             / results["link_h2d_gbps"], 3)
     _bench_into(results, "scalar_encode", plugin="tpu", mode="scalar",
                 workload="encode", iterations=2, warmup=1)
+    # real multi-chip backend: this stage carries the authoritative
+    # device-count scaling curve (cluster_tpu's virtual-device child
+    # fills it in on single-device backends)
+    if len(jax.devices()) >= 2:
+        try:
+            results.update(_mesh_scaling_body())
+        except Exception as e:
+            log(f"mesh_scaling: FAILED {type(e).__name__}: {e}")
     results["elapsed_s"] = round(time.perf_counter() - t0, 1)
     return results
 
@@ -277,6 +285,135 @@ def stage_cluster() -> dict:
         results["health"] = {"status": f"probe failed: "
                                        f"{type(e).__name__}: {e}"}
     return results
+
+
+# -- mesh scaling curve -------------------------------------------------------
+
+SCALING_COUNTS = (1, 2, 4, 8)
+
+
+def _mesh_scaling_body() -> dict:
+    """Device-count scaling of the sharded stripe encode (the offload
+    service's oversized-batch path): the SAME fixed workload timed over
+    1/2/4/8-device meshes via parallel.sharded_apply_fn, plus a
+    bit-identity check of the widest mesh against the 1-device result.
+
+    scaling_efficiency is normalized by the parallelism the hardware
+    can actually deliver: on real multi-chip meshes that is the device
+    count; on virtual host devices (xla_force_host_platform_device_count
+    carving one CPU into 8 "devices") it is capped at the core count —
+    8 virtual devices on 2 cores can never beat 2x, and pretending the
+    ideal is 8x would make the number meaningless. The raw (device-
+    normalized) efficiency is reported alongside, labeled."""
+    import jax
+
+    from ceph_tpu.ec import gf256
+    from ceph_tpu.parallel import mesh as mesh_lib
+
+    devs = jax.devices()
+    platform = devs[0].platform
+    counts = [c for c in SCALING_COUNTS if c <= len(devs)]
+    K8, M3 = 8, 3
+    C = 1 << 16                      # 64 KiB chunks
+    B = max(8, counts[-1])           # fixed total work (strong scaling)
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (B, K8, C), dtype=np.uint8)
+    coding = gf256.reed_sol_van_matrix(K8, M3)
+    curve: dict[str, float] = {}
+    outputs: dict[int, np.ndarray] = {}
+    for n in counts:
+        # stripe-only meshes, matching the offload service's serving
+        # mesh: the stripe axis is pure data parallelism (no all-gather,
+        # no padded parity rows), which is what the fan-out scales over
+        mesh = mesh_lib.make_mesh(n, stripe=n, shard_max=1)
+        fn = mesh_lib.sharded_apply_fn(mesh, coding)
+        outputs[n] = np.asarray(fn(data))        # compile + warm
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn(data)
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        gbps = B * K8 * C / times[len(times) // 2] / 1e9
+        curve[str(n)] = round(gbps, 4)
+        log(f"mesh_scaling: {n} device(s) "
+            f"{dict(mesh.shape)} -> {curve[str(n)]} GB/s")
+    n_max = counts[-1]
+    bit_identical = bool(np.array_equal(outputs[n_max], outputs[counts[0]]))
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    virtual = platform == "cpu"      # host devices share the host cores
+    ideal = min(n_max, cores) if virtual else n_max
+    g1, gn = curve[str(counts[0])], curve[str(n_max)]
+    out = {
+        "device_scaling_gb_s": curve,
+        "scaling_devices": n_max,
+        "scaling_platform": platform,
+        "scaling_virtual_devices": virtual,
+        "scaling_ideal_parallelism": ideal,
+        "scaling_bit_identical": bit_identical,
+        "scaling_efficiency_raw": round(gn / (n_max * g1), 4)
+        if g1 else 0.0,
+        "scaling_efficiency": round(gn / (ideal * g1), 4)
+        if g1 else 0.0,
+    }
+    log(f"mesh_scaling: efficiency {out['scaling_efficiency']} "
+        f"(ideal x{ideal}, raw {out['scaling_efficiency_raw']} over "
+        f"{n_max} {'virtual ' if virtual else ''}devices), "
+        f"bit_identical={bit_identical}")
+    return out
+
+
+def stage_mesh_scaling() -> dict:
+    """Child entry for the scaling curve (spawned with
+    xla_force_host_platform_device_count when the parent's backend has
+    a single device)."""
+    return _mesh_scaling_body()
+
+
+def _device_scaling_curve() -> dict:
+    """The scaling curve via a hermetic 8-virtual-device child — only
+    for single-device backends (on real multi-chip hardware the device
+    stage already ran _mesh_scaling_body in-process, and its keys win
+    the bench.py detail merge; running it again here would double the
+    mesh compile + timing cost per round)."""
+    import subprocess
+
+    import jax
+    if len(jax.devices()) >= 2:
+        log("mesh_scaling: skipped (device stage covers multi-device "
+            "backends)")
+        return {}
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("JAX_PLATFORM_NAME", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "ceph_tpu.tools.bench_driver",
+             "--stage", "mesh_scaling"],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=180)
+    except Exception as e:
+        log(f"mesh_scaling child: FAILED {type(e).__name__}: {e}")
+        return {}
+    sys.stderr.write(proc.stderr)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                break
+    log(f"mesh_scaling child: no JSON (rc={proc.returncode})")
+    return {}
 
 
 def stage_cluster_tpu() -> dict:
@@ -417,6 +554,8 @@ def stage_cluster_tpu() -> dict:
 
     asyncio.run(asyncio.wait_for(body(), 240))
     asyncio.run(asyncio.wait_for(datapath(), 120))
+    # device-count scaling curve of the mesh fan-out path (1/2/4/8)
+    results.update(_device_scaling_curve())
     results["elapsed_s"] = round(_t.perf_counter() - t0, 1)
     return results
 
@@ -779,7 +918,13 @@ def attribution_from_spans(spans: list[dict]) -> dict:
                 buckets["queue_wait"] += s["duration_us"]
             elif name in ("ec_encode", "ec_decode", "offload_batch"):
                 buckets["copy"] += float(tags.get("copy_us") or 0.0)
-            if name in ("tpu_encode_dispatch", "tpu_decode_dispatch"):
+            # offload_batch carries the h2d/kernel/d2h splits when the
+            # service staged the dispatch itself (mesh fan-out hands the
+            # plugin a device-resident array, so the plugin spans no
+            # longer see the transfers); plugin device-mode spans carry
+            # no timing tags, so the two sources never double-count
+            if name in ("tpu_encode_dispatch", "tpu_decode_dispatch",
+                        "offload_batch"):
                 buckets["h2d"] += float(tags.get("h2d_us") or 0.0)
                 buckets["kernel"] += float(tags.get("kernel_us") or 0.0)
                 buckets["d2h"] += float(tags.get("d2h_us") or 0.0)
@@ -889,6 +1034,17 @@ def stage_attribution() -> dict:
                         "batches": d["batches"] - base.get("batches", 0),
                         "ops": d["ops"] - base.get("ops", 0),
                     }
+                # fan-out balance: busy-fraction skew across the
+                # accelerator slots that saw traffic this window
+                # ((max-min)/max; 0 = perfectly balanced, trend-guarded
+                # so a routing regression shows up as a rise)
+                active = [d["busy_fraction"]
+                          for dev, d in att["per_device"].items()
+                          if dev != "host" and d["busy_fraction"] > 0]
+                att["device_busy_skew"] = round(
+                    (max(active) - min(active)) / max(active), 4) \
+                    if len(active) >= 2 else 0.0
+                results["device_busy_skew"] = att["device_busy_skew"]
                 results["attribution"] = att
                 results["copy_amplification"] = att["copy_amplification"]
                 results["loop_busy_fraction"] = att["loop_busy_fraction"]
@@ -919,15 +1075,18 @@ def stage_attribution() -> dict:
 # committed BENCH_r*.json and embeds the verdict in the output line, so
 # a silent slide becomes a loud `regression_pct` the round it happens.
 
-TREND_KEYS = ("tpu_encode", "tpu_decode", "failure_storm_recovery_mb_s")
+TREND_KEYS = ("tpu_encode", "tpu_decode", "failure_storm_recovery_mb_s",
+              "scaling_efficiency")
 #: keys where UP is the regression direction: more copied bytes per
-#: written byte, a busier event loop, a slower recovery to clean, or a
-#: repair fetch creeping back toward the full-stripe baseline is a
-#: slide even when the GB/s numbers hold. Guarded once two rounds
-#: carry them (older rounds simply lack the keys).
+#: written byte, a busier event loop, a slower recovery to clean, a
+#: repair fetch creeping back toward the full-stripe baseline, or the
+#: mesh fan-out leaving devices idle is a slide even when the GB/s
+#: numbers hold. Guarded once two rounds carry them (older rounds
+#: simply lack the keys).
 TREND_KEYS_COST = ("copy_amplification", "loop_busy_fraction",
                    "failure_storm_time_to_clean_s",
-                   "failure_storm_repair_ratio")
+                   "failure_storm_repair_ratio",
+                   "device_busy_skew")
 TREND_THRESHOLD_PCT = 10.0
 
 
@@ -1010,14 +1169,16 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--stage", choices=["cpu", "probe", "device",
                                        "cluster", "cluster_tpu",
-                                       "attribution", "failure_storm"],
+                                       "attribution", "failure_storm",
+                                       "mesh_scaling"],
                    required=True)
     args = p.parse_args()
     out = {"cpu": stage_cpu, "probe": stage_probe,
            "device": stage_device, "cluster": stage_cluster,
            "cluster_tpu": stage_cluster_tpu,
            "attribution": stage_attribution,
-           "failure_storm": stage_failure_storm}[args.stage]()
+           "failure_storm": stage_failure_storm,
+           "mesh_scaling": stage_mesh_scaling}[args.stage]()
     print(json.dumps(out), flush=True)
     return 0
 

@@ -61,12 +61,38 @@ async def reap(task: asyncio.Task | None) -> None:
 
 
 async def reap_all(tasks: Iterable[asyncio.Task | None]) -> None:
-    """Cancel every task first (concurrent teardown), then await each."""
+    """Cancel every task first (concurrent teardown), then await each.
+
+    Cancellation-complete: when the reaping task is ITSELF cancelled
+    mid-loop, the first reap() re-raises — the old version then skipped
+    the remaining tasks, leaving them cancelled-but-never-awaited, i.e.
+    pending at loop close ("Task was destroyed but it is pending!", the
+    messenger _pump sub-task flavor of the BENCH_r05 tail spam). Our
+    own CancelledError is held until every task has been awaited, then
+    re-raised — teardown stays cancellable without abandoning work."""
     live = [t for t in tasks if t is not None]
     for t in live:
         t.cancel()
+    interrupted: asyncio.CancelledError | None = None
     for t in live:
-        await reap(t)
+        try:
+            await reap(t)
+        # deferred re-raise below, once every task is done — not a
+        # swallow
+        # radoslint: disable-next=cancellation-swallow
+        except asyncio.CancelledError as e:
+            interrupted = e          # finish reaping before unwinding
+            if not t.done():
+                # our own cancel interrupted THIS task's reap — await it
+                # through (it is already cancelled); a repeated cancel
+                # during the retry abandons it as the last resort
+                try:
+                    await reap(t)
+                # radoslint: disable-next=cancellation-swallow
+                except asyncio.CancelledError:
+                    pass
+    if interrupted is not None:
+        raise interrupted
 
 
 async def drain(task: asyncio.Task | None) -> None:
@@ -87,8 +113,26 @@ async def drain(task: asyncio.Task | None) -> None:
 
 
 async def drain_all(tasks: Iterable[asyncio.Task | None]) -> None:
+    """drain() each task; like reap_all, our own cancellation is held
+    until every task was awaited (abandoning the tail leaks it)."""
+    interrupted: asyncio.CancelledError | None = None
     for t in list(tasks):
-        await drain(t)
+        try:
+            await drain(t)
+        # deferred re-raise below, once every task was awaited
+        # radoslint: disable-next=cancellation-swallow
+        except asyncio.CancelledError as e:
+            interrupted = e
+            if t is not None and not t.done():
+                # finish waiting out the interrupted task; a repeated
+                # cancel during the retry abandons it as the last resort
+                try:
+                    await drain(t)
+                # radoslint: disable-next=cancellation-swallow
+                except asyncio.CancelledError:
+                    pass
+    if interrupted is not None:
+        raise interrupted
 
 
 async def bounded_stop(coro, timeout: float) -> bool:

@@ -1,0 +1,10 @@
+"""Known-positive decl-use: the mesh fan-out surface rotted — a dead
+ec_offload_device_* knob no observer family covers, and a per-device
+perf counter that would graph forever-zero."""
+
+
+def declare(config, perf, Option):
+    config.declare(Option("ec_offload_device_dead_knob", "int", 0,
+                          "a routing knob nobody consults"))
+    perf.add("offload_device_ghost_batches",
+             description="per-device counter never incremented")

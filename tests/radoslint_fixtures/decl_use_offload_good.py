@@ -1,0 +1,45 @@
+"""Known-negative decl-use: the ec_offload_device_* knob family and the
+per-device perf counters declared the way offload/service.py really
+declares them — options hot-applied through an observer that slices the
+shared prefix (the lint's prefix-const heuristic must honor the family
+as live), counters incremented on the dispatch path."""
+
+_DEFAULTS = {"device_count": 0, "device_shard_bytes": 32 << 20}
+
+
+def OPTIONS(Option):
+    return [Option("ec_offload_device_count", "int",
+                   _DEFAULTS["device_count"],
+                   "applied via the observer below"),
+            Option("ec_offload_device_shard_bytes", "size",
+                   _DEFAULTS["device_shard_bytes"],
+                   "applied via the observer below")]
+
+
+def register_config(config, Option, service):
+    names = []
+    for opt in OPTIONS(Option):
+        names.append(opt.name)
+        config.declare(opt)
+
+    def _on_change(name, value):
+        key = name[len("ec_offload_"):]
+        if key in _DEFAULTS:
+            _DEFAULTS[key] = value
+        service.apply_setting(name, value)
+
+    config.add_observer(tuple(names), _on_change)
+
+
+def declare_counters(perf):
+    perf.add("offload_device_spills",
+             description="incremented on spillover below")
+    perf.add("offload_mesh_batches",
+             description="incremented on mesh dispatch below")
+
+
+def dispatch(perf, spilled, meshed):
+    if spilled:
+        perf.inc("offload_device_spills")
+    if meshed:
+        perf.inc("offload_mesh_batches")

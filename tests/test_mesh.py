@@ -68,3 +68,27 @@ def test_pipeline_step_rejects_too_many_erasures():
     mesh = _mesh()
     with pytest.raises(ValueError):
         mesh_lib.sharded_pipeline_step_fn(mesh, K, M, (0, 1, 2, 3))
+
+
+@pytest.mark.parametrize("rows", [3, 8, 13])   # non-multiples pad
+def test_sharded_apply_fn_numpy_roundtrip(rows):
+    """The offload service's oversized-batch dispatch shape: numpy in,
+    numpy out, stripe-axis padding transparent, bit-exact vs the host
+    codec — for an encode matrix AND a recovery matrix."""
+    from ceph_tpu.ops import rs_codec
+    mesh = _mesh(stripe=8, shard_max=1)
+    coding = gf256.reed_sol_van_matrix(K, M)
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, (rows, K, 1024), dtype=np.uint8)
+    fn = mesh_lib.sharded_apply_fn(mesh, coding)
+    parity = fn(data)
+    assert parity.shape == (rows, M, 1024)
+    expect = np.stack([gf256.mat_vec_apply(coding, data[i])
+                       for i in range(rows)])
+    np.testing.assert_array_equal(parity, expect)
+    # recovery-matrix flavor (the DecodeJob mesh path)
+    avail = tuple(i for i in range(K + M) if i not in (0, 1, 2))[:K]
+    R = rs_codec.recovery_matrix(coding, avail, (0, 1, 2))
+    full = np.concatenate([data, parity], axis=1)
+    rec = mesh_lib.sharded_apply_fn(mesh, R)(full[:, avail, :])
+    np.testing.assert_array_equal(rec, full[:, (0, 1, 2), :])

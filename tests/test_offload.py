@@ -10,6 +10,7 @@ TPU_OFFLOAD_DEGRADED), and the admin-socket/config surfaces.
 from __future__ import annotations
 
 import asyncio
+import time
 
 import numpy as np
 import pytest
@@ -209,6 +210,166 @@ def test_inline_bypass_when_disabled():
         finally:
             offload.set_enabled(True)
     run(body(), timeout=60)
+
+
+# ---------------------------------------------------------------------------
+# mesh fan-out: routing, sharding, per-device breakers, device rows
+# ---------------------------------------------------------------------------
+
+def test_device_affine_routing_with_least_busy_spillover():
+    """Same bucket key -> same device while it keeps up (compile-cache
+    warmth); a backed-up preferred device spills to the least-busy one;
+    with every device out of rotation the router yields None (host)."""
+    async def body():
+        svc = offload.get_service()
+        slots = svc._topology()
+        assert len(slots) == 8               # conftest: 8 virtual devices
+        key = ("enc", b"matrix", 4096)
+        pref = slots[hash(key) % len(slots)]
+        for _ in range(4):                   # idle: affinity is stable
+            assert svc._route(key) is pref
+        pref.inflight = svc.device_spill_threshold
+        try:
+            spill = svc._route(key)
+            assert spill is not pref
+            assert spill.inflight == 0       # least busy won
+        finally:
+            pref.inflight = 0
+        for s in slots:                      # all tripped -> host lane
+            s.degraded = True
+            s.degraded_since = time.monotonic()
+        try:
+            assert svc._route(key) is None
+            assert svc.degraded              # TPU_OFFLOAD_DEGRADED state
+        finally:
+            for s in slots:
+                s.degraded = False
+        assert not svc.degraded
+    run(body(), timeout=60)
+
+
+def test_oversized_batch_stripe_shards_bit_identical():
+    """A batch at device_shard_bytes fans across the whole mesh through
+    sharded_encode_fn — output bit-identical to the single-device
+    dispatch, counted as a mesh batch."""
+    async def body():
+        impl = _impl()
+        sinfo = ec_util.StripeInfo(4, 4 * 1024)
+        svc = offload.get_service()
+        svc.linger_ms = 2.0
+        prev = svc.device_shard_bytes
+        svc.device_shard_bytes = 32 * 1024
+        try:
+            data = bytes(range(256)) * 16 * 64      # 256 KiB = 64 stripes
+            ref = ec_util.encode(sinfo, impl, data)  # single-device path
+            base = dict(svc.stats)
+            out = await asyncio.wait_for(
+                ec_util.encode_async(sinfo, impl, data, service=svc), 60)
+            assert out == ref                        # bit-identical
+            d = {k: svc.stats[k] - base[k] for k in base}
+            assert d["mesh_batches"] == 1
+            assert d["fallback_ops"] == 0
+            st = svc.status()
+            assert st["mesh"]["devices"] == 8
+            assert st["mesh"]["shape"] == {"stripe": 8, "shard": 1}
+        finally:
+            svc.device_shard_bytes = prev
+        await svc.drain()
+    run(body(), timeout=120)
+
+
+def test_per_device_breaker_isolates_one_chip(monkeypatch):
+    """One chip failing fails its in-flight batch over to the next
+    healthy chip: no host fallback, no service-wide degradation, only
+    the victim leaves rotation."""
+    async def body():
+        impl = _impl()
+        sinfo = ec_util.StripeInfo(4, 4 * 1024)
+        svc = offload.get_service()
+        svc.linger_ms = 2.0
+        slots = svc._topology()
+        data = bytes(range(256)) * 64
+        ref = ec_util.encode(sinfo, impl, data)
+        key = ("enc", impl.coding_matrix.tobytes(), sinfo.chunk_size)
+        victim = slots[hash(key) % len(slots)]
+        orig = svc._device_call
+
+        async def boom(slot, fn, stacked, sp=None):
+            if slot is victim:
+                raise RuntimeError("chip down")
+            return await orig(slot, fn, stacked, sp)
+        monkeypatch.setattr(svc, "_device_call", boom)
+
+        base = dict(svc.stats)
+        out = await ec_util.encode_async(sinfo, impl, data, service=svc)
+        assert out == ref
+        d = {k: svc.stats[k] - base[k] for k in base}
+        assert victim.degraded                   # victim out of rotation
+        assert not svc.degraded                  # service still healthy
+        assert d["breaker_trips"] == 1
+        assert d["device_failovers"] >= 1        # batch failed over
+        assert d["fallback_ops"] == 0            # never reached host
+        hm = svc.health_metrics()
+        assert not hm["degraded"] and hm["devices_out"] == 1
+        # follow-up batches route around the victim without new trips
+        base2 = dict(svc.stats)
+        out2 = await ec_util.encode_async(sinfo, impl, data, service=svc)
+        assert out2 == ref
+        assert svc.stats["breaker_trips"] == base2["breaker_trips"]
+        assert svc.stats["fallback_ops"] == base2["fallback_ops"]
+        await svc.drain()
+    run(body(), timeout=120)
+
+
+def test_device_stats_and_exporter_rows_for_every_mesh_device(monkeypatch):
+    """Concurrent distinct-bucket batches under load rotate over ALL
+    mesh devices (spill threshold 1), and each device's stats render as
+    a ceph_device-labeled exporter row."""
+    from ceph_tpu.mgr.daemon import DaemonStateIndex
+    from ceph_tpu.mgr.exporter import render_metrics
+
+    async def body():
+        impl = _impl()
+        svc = offload.get_service()
+        slots = svc._topology()
+        svc.linger_ms = 1.0
+        prev_spill, prev_batch = svc.device_spill_threshold, \
+            svc.max_batch_bytes
+        svc.device_spill_threshold = 1
+        svc.max_batch_bytes = 4096           # every submit flushes
+
+        from ceph_tpu.offload.service import _host_apply
+
+        async def slow(slot, fn, stacked, sp=None):
+            await asyncio.sleep(0.05)        # keep slots busy to rotate
+            return _host_apply(impl.coding_matrix, stacked)
+        monkeypatch.setattr(svc, "_device_call", slow)
+        try:
+            # 16 distinct bucket keys (one per chunk size) in flight at
+            # once: with spill threshold 1 every new batch lands on an
+            # idle slot while one exists
+            jobs = []
+            for i in range(1, 17):
+                sinfo = ec_util.StripeInfo(4, 4 * 1024 * i)
+                data = bytes(4 * 1024 * i)
+                jobs.append(ec_util.encode_async(sinfo, impl, data,
+                                                 service=svc))
+            await asyncio.wait_for(asyncio.gather(*jobs), 60)
+        finally:
+            svc.device_spill_threshold = prev_spill
+            svc.max_batch_bytes = prev_batch
+        seen = set(svc.device_snapshot())
+        assert {s.label for s in slots} <= seen
+        # report path: one ceph_device row per mesh device
+        index = DaemonStateIndex()
+        index.report({"daemon_name": "osd.9", "service": "osd",
+                      "device_metrics": svc.device_metrics()})
+        text = render_metrics(None, index=index)
+        for s in slots:
+            assert (f'ceph_offload_device_batches{{ceph_daemon="osd.9",'
+                    f'ceph_device="{s.label}"}}') in text
+        await svc.drain()
+    run(body(), timeout=120)
 
 
 # ---------------------------------------------------------------------------

@@ -40,6 +40,8 @@ def lint(path, rules):
     ("decl-use", "decl_use_bad.py", 5, "decl_use_good.py"),
     ("decl-use", "decl_use_faultinject_bad.py", 2,
      "decl_use_faultinject_good.py"),
+    ("decl-use", "decl_use_offload_bad.py", 2,
+     "decl_use_offload_good.py"),
     ("report-export-consistency", "report_export_bad.py", 1,
      "report_export_good.py"),
 ])
