@@ -36,9 +36,20 @@ sys.path.insert(0, REPO)
 
 from ceph_tpu.utils import tracer  # noqa: E402
 TOTAL_BUDGET = int(os.environ.get("CEPH_TPU_BENCH_TIMEOUT", "2400"))
+# reactor shard knob: the cluster_tpu stage sweeps 1/2/4 shards up to
+# this cap, and the attribution stage profiles the sharded runtime
+# (same guarded parse as bench_driver._reactor_shards_knob — a
+# malformed value must not kill the bench before any stage runs)
+try:
+    REACTOR_SHARDS = max(1, int(
+        os.environ.get("CEPH_TPU_REACTOR_SHARDS", "4")))
+except ValueError:
+    REACTOR_SHARDS = 4
 CPU_TIMEOUT = 420
 DEVICE_TIMEOUT = 900  # single long warm: backend init + benches, one child
-CLUSTER_TPU_TIMEOUT = 420  # in-situ EC-over-tpu cluster stage
+CLUSTER_TPU_TIMEOUT = 620  # in-situ EC-over-tpu cluster stage: body
+#                            (240) + datapath (120) + reactor shard
+#                            curve (180) + scaling child headroom
 ATTRIBUTION_TIMEOUT = 240  # hermetic attribution-profiler stage
 FAILURE_STORM_TIMEOUT = 320  # kill/revive resilience + repair-ratio stage
 METRIC = "ec_encode_k8m3_1MiB_chunk"
@@ -55,12 +66,14 @@ def _hermetic_env() -> dict:
     env.pop("PALLAS_AXON_POOL_IPS", None)  # axon sitecustomize trigger
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["CEPH_TPU_REACTOR_SHARDS"] = str(REACTOR_SHARDS)
     return env
 
 
 def _tpu_env() -> dict:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["CEPH_TPU_REACTOR_SHARDS"] = str(REACTOR_SHARDS)
     return env
 
 
@@ -218,6 +231,7 @@ def main() -> int:
         "attribution": attribution.get("attribution"),
         "baseline": baseline_name,
         "platform": device.get("platform", "none"),
+        "reactor_shards": REACTOR_SHARDS,
         "detail": detail,
         "stages": {name: {k: s.get(k) for k in
                           ("status", "elapsed_s", "platform", "backend_init_s",

@@ -93,7 +93,11 @@ class Frame:
 
     MAX_SEGMENT_SIZE = 128 << 20   # sanity bound; a segment is <= one op
 
-    def encode(self) -> bytes:
+    def _parts(self) -> list:
+        """Wire form as a scatter list: [preamble, seg0, crc0, seg1,
+        crc1, ...] — the preamble/crc trailers are fresh small bytes,
+        every segment is passed BY REFERENCE (no ledger accounting
+        here; encode/encode_parts meter their own copy behavior)."""
         if not 0 <= len(self.segments) <= MAX_SEGMENTS:
             raise FrameError(f"{len(self.segments)} segments (max "
                              f"{MAX_SEGMENTS})")
@@ -102,29 +106,47 @@ class Frame:
         for seg in self.segments:
             pre += _U32.pack(len(seg))
         pre += _U32.pack(crc32c(bytes(pre)))
-        # checksums computed OUTSIDE the timed window: the ledger's
-        # frame_tx seconds must meter byte movement only, or a zero-copy
-        # change that leaves CRC alone under-reports its own win
-        crcs = [_U32.pack(crc32c(seg)) for seg in self.segments]
+        parts: list = [bytes(pre)]
+        for seg in self.segments:
+            parts.append(seg)
+            parts.append(_U32.pack(crc32c(seg)))
+        return parts
+
+    def encode_parts(self) -> list:
+        """Scatter-gather wire form for the plain-crc transport path:
+        the write loop hands these buffers to the transport
+        (writelines), whose single outbound join is the ONE copy each
+        segment pays — down from two in the old assemble-then-bytes()
+        encode(). Metered as one tx copy; the Onwire modes still need
+        the contiguous blob (they transform whole frames) and use
+        encode()."""
+        parts = self._parts()
+        copytrack.copied("frame_tx", sum(len(s) for s in self.segments))
+        return parts
+
+    def encode(self) -> bytes:
+        # crcs/preamble are built OUTSIDE the timed window: the
+        # ledger's frame_tx seconds must meter byte movement only, or a
+        # zero-copy change that leaves CRC alone under-reports its win
+        parts = self._parts()
         t0 = time.perf_counter()
-        out = bytearray(pre)
-        for seg, c in zip(self.segments, crcs):
-            out += seg
-            out += c
-        blob = bytes(out)
-        # every segment byte is copied into the wire blob (then the blob
-        # itself is materialized once more by bytes()): the msgr2 tx-side
-        # copy the zero-copy discipline wants to see shrink
-        copytrack.copied("frame_tx", 2 * sum(len(s) for s in self.segments),
+        blob = b"".join(parts)
+        # one join: each segment byte is copied exactly once into the
+        # wire blob (the old bytearray-accumulate + bytes() paid twice)
+        copytrack.copied("frame_tx", sum(len(s) for s in self.segments),
                          time.perf_counter() - t0)
         return blob
 
     @classmethod
     async def read(cls, reader) -> "Frame":
-        """Read one frame from an asyncio StreamReader (gathers the
-        bytes, then parses through the one shared decode path)."""
+        """Read one frame from an asyncio StreamReader. The preamble is
+        read and validated separately from the body, and segments come
+        back as MEMORYVIEWS over the single body buffer — the receive
+        side never re-slices payload bytes into fresh objects (the
+        frame_rx copy the PR-6 ledger indicted; it now meters as
+        referenced, not copied)."""
         fixed = await reader.readexactly(_PRE_FIXED.size)
-        magic, _tag, nseg = _PRE_FIXED.unpack(fixed)
+        magic, tag, nseg = _PRE_FIXED.unpack(fixed)
         if magic != MAGIC:
             raise FrameError(f"bad magic {magic:#x}")
         if nseg > MAX_SEGMENTS:
@@ -134,13 +156,46 @@ class Frame:
         for ln in seg_lens:
             if ln > cls.MAX_SEGMENT_SIZE:
                 raise FrameError(f"segment of {ln} bytes exceeds bound")
+        (pre_crc,) = _U32.unpack_from(rest, 4 * nseg)
+        if crc32c(fixed + rest[:4 * nseg]) != pre_crc:
+            raise FrameError("preamble crc mismatch")
         body = await reader.readexactly(sum(ln + 4 for ln in seg_lens))
-        return cls.decode(fixed + rest + body)
+        try:
+            tag = Tag(tag)
+        except ValueError as e:
+            raise FrameError(f"unknown tag {tag}") from e
+        return cls(tag, cls._parse_segments(seg_lens, memoryview(body)))
+
+    @classmethod
+    def _parse_segments(cls, seg_lens: list[int],
+                        body: memoryview) -> list[memoryview]:
+        """crc-verify and window each segment out of the body buffer —
+        zero-copy: every returned segment is a view, and the buffer
+        stays alive exactly as long as any segment does (refcounted)."""
+        try:
+            segments: list[memoryview] = []
+            off = 0
+            for ln in seg_lens:
+                seg = body[off:off + ln]
+                if len(seg) != ln:
+                    raise FrameError("truncated segment")
+                (seg_crc,) = _U32.unpack_from(body, off + ln)
+                if crc32c(seg) != seg_crc:
+                    raise FrameError("segment crc mismatch")
+                segments.append(seg)
+                off += ln + 4
+        except struct.error as e:
+            raise FrameError(f"truncated frame: {e}") from e
+        # rx-side: segments are windows over the recv buffer, no copy
+        copytrack.referenced("frame_rx", sum(seg_lens))
+        return segments
 
     @classmethod
     def decode(cls, blob: bytes) -> "Frame":
-        """Parse one whole frame from bytes — the single parser behind
-        both read() and the Onwire unwrap path."""
+        """Parse one whole frame from bytes — the Onwire unwrap path
+        (the transform already materialized the plaintext blob) and any
+        caller holding a complete frame. Segments are memoryviews over
+        `blob`."""
         try:
             if len(blob) < _PRE_FIXED.size:
                 raise FrameError("short frame")
@@ -152,32 +207,22 @@ class Frame:
             off = _PRE_FIXED.size
             seg_lens = [_U32.unpack_from(blob, off + 4 * i)[0]
                         for i in range(nseg)]
-            (pre_crc,) = _U32.unpack_from(blob, off + 4 * nseg)
-            if crc32c(blob[:off + 4 * nseg]) != pre_crc:
-                raise FrameError("preamble crc mismatch")
-            off += 4 * nseg + 4
-            segments = []
             for ln in seg_lens:
                 if ln > cls.MAX_SEGMENT_SIZE:
                     raise FrameError(f"segment of {ln} bytes exceeds "
                                      f"bound")
-                seg = blob[off:off + ln]
-                if len(seg) != ln:
-                    raise FrameError("truncated segment")
-                (seg_crc,) = _U32.unpack_from(blob, off + ln)
-                if crc32c(seg) != seg_crc:
-                    raise FrameError("segment crc mismatch")
-                segments.append(seg)
-                off += ln + 4
+            (pre_crc,) = _U32.unpack_from(blob, off + 4 * nseg)
+            if crc32c(blob[:off + 4 * nseg]) != pre_crc:
+                raise FrameError("preamble crc mismatch")
+            off += 4 * nseg + 4
         except struct.error as e:
             raise FrameError(f"truncated frame: {e}") from e
         try:
             tag = Tag(tag)
         except ValueError as e:
             raise FrameError(f"unknown tag {tag}") from e
-        # rx-side: each segment is sliced (copied) out of the wire blob
-        copytrack.copied("frame_rx", sum(len(s) for s in segments))
-        return cls(tag, segments)
+        return cls(tag, cls._parse_segments(seg_lens,
+                                            memoryview(blob)[off:]))
 
 
 class Onwire:

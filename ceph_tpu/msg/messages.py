@@ -15,9 +15,21 @@ bytes. Compact, debuggable, and versionable via key presence.
 from __future__ import annotations
 
 import json
+import time
 from typing import Any
 
+from ceph_tpu.utils import copytrack
+
 _REGISTRY: dict[int, type] = {}
+
+
+def _json_seg(seg) -> Any:
+    """json.loads over a frame segment; segments arrive as memoryviews
+    (zero-copy rx) and json needs bytes — these are control-plane blobs
+    of a few hundred bytes, so the materialization is noise."""
+    if not isinstance(seg, (bytes, bytearray, str)):
+        seg = bytes(seg)
+    return json.loads(seg)
 
 
 def register_message(cls):
@@ -33,6 +45,14 @@ class Message:
     """Base message. Subclasses set TYPE and may override describe()."""
 
     TYPE = 0
+
+    #: data-plane message types keep their data segment as a zero-copy
+    #: MEMORYVIEW over the receive buffer (frame_rx stays referenced in
+    #: the copy ledger); control-plane types materialize bytes — their
+    #: handlers (paxos store persistence, latin1 decode, json
+    #: re-encode) expect bytes semantics and carry a few hundred bytes
+    #: at most, so the copy is noise while the API stays exact.
+    DATA_VIEW = False
 
     def __init__(self, payload: dict[str, Any] | None = None,
                  data: bytes = b""):
@@ -61,12 +81,19 @@ class Message:
     def decode_segments(segments: list[bytes]) -> "Message":
         if len(segments) not in (3, 4):
             raise ValueError(f"message frame has {len(segments)} segments")
-        header = json.loads(segments[0])
+        header = _json_seg(segments[0])
         cls = _REGISTRY.get(header["type"])
         if cls is None:
             raise ValueError(f"unknown message type {header['type']}")
+        data = segments[2]
+        if not cls.DATA_VIEW and not isinstance(data, (bytes, bytearray)):
+            # control-plane type: materialize (and meter) the copy
+            t0 = time.perf_counter()
+            data = bytes(data)
+            copytrack.copied("frame_rx", len(data),
+                             time.perf_counter() - t0)
         msg = cls.__new__(cls)
-        Message.__init__(msg, json.loads(segments[1]), segments[2])
+        Message.__init__(msg, _json_seg(segments[1]), data)
         msg.seq = header["seq"]
         if len(segments) == 4:
             # unknown trailing segments are dropped, not errors: a newer
@@ -82,9 +109,11 @@ class Message:
                 f"data={len(self.data)}B)")
 
 
-def _simple(type_id: int, name: str):
-    """Define + register a Message subclass with no extra behavior."""
-    cls = type(name, (Message,), {"TYPE": type_id})
+def _simple(type_id: int, name: str, data_view: bool = False):
+    """Define + register a Message subclass with no extra behavior.
+    `data_view=True` marks a data-plane carrier whose payload stays a
+    zero-copy memoryview on receive (see Message.DATA_VIEW)."""
+    cls = type(name, (Message,), {"TYPE": type_id, "DATA_VIEW": data_view})
     return register_message(cls)
 
 
@@ -122,28 +151,31 @@ MOSDBoot = _simple(0x30, "MOSDBoot")              # {"osd": id, "addr": str}
 MOSDFailure = _simple(0x32, "MOSDFailure")        # {"failed": id, "from": id}
 
 # -- client I/O (MOSDOp/MOSDOpReply, src/messages/MOSDOp.h) ------------------
-MOSDOp = _simple(0x40, "MOSDOp")          # {"tid", "pg": "pool.ps", "oid",
+MOSDOp = _simple(0x40, "MOSDOp",  # {"tid", "pg": "pool.ps", "oid",
+                 data_view=True)
                                           #  "ops": [{"op": "write"|"read"|...,
                                           #          "off", "len", ...}],
                                           #  "epoch": client map epoch}
 MOSDOpReply = _simple(0x41, "MOSDOpReply")  # {"tid", "rc", "out": [...]}
 
 # -- replication (MOSDRepOp, src/messages/MOSDRepOp.h) -----------------------
-MOSDRepOp = _simple(0x50, "MOSDRepOp")            # primary -> replica txn
+MOSDRepOp = _simple(0x50, "MOSDRepOp",       # primary -> replica txn
+                    data_view=True)
 MOSDRepOpReply = _simple(0x51, "MOSDRepOpReply")
 
 # -- peering / pg info -------------------------------------------------------
 MOSDPGQuery = _simple(0x60, "MOSDPGQuery")
 MOSDPGInfo = _simple(0x61, "MOSDPGInfo")
 MOSDPGLog = _simple(0x62, "MOSDPGLog")
-MOSDPGPush = _simple(0x63, "MOSDPGPush")          # recovery object push
+MOSDPGPush = _simple(0x63, "MOSDPGPush",     # recovery object push
+                     data_view=True)
 MOSDPGPushReply = _simple(0x64, "MOSDPGPushReply")
 
 # -- EC sub-ops (MOSDECSubOpWrite/Read, src/messages/MOSDECSubOp*.h) ---------
-MOSDECSubOpWrite = _simple(0x70, "MOSDECSubOpWrite")
+MOSDECSubOpWrite = _simple(0x70, "MOSDECSubOpWrite", data_view=True)
 MOSDECSubOpWriteReply = _simple(0x71, "MOSDECSubOpWriteReply")
 MOSDECSubOpRead = _simple(0x72, "MOSDECSubOpRead")
-MOSDECSubOpReadReply = _simple(0x73, "MOSDECSubOpReadReply")
+MOSDECSubOpReadReply = _simple(0x73, "MOSDECSubOpReadReply", data_view=True)
 
 # -- watch/notify (MWatchNotify, src/messages/MWatchNotify.h) ----------------
 MWatchNotify = _simple(0x90, "MWatchNotify")        # osd -> watcher client:

@@ -13,10 +13,16 @@ The reference contract this keeps (src/msg/Messenger.h, ProtocolV2.cc):
     in_seq/out_seq + ACK frames bound replay; receivers drop duplicates
     by seq (ProtocolV2 reconnect/replay, out-of-order-safe).
 
-Idiomatic divergences: one asyncio event loop per process instead of
-epoll worker threads; coroutine-per-connection instead of a hand-rolled
-state machine; the banner/HELLO exchange carries JSON instead of
-dencoded structs. Auth: `none` by default, cephx-lite mutual HMAC when
+Idiomatic divergences: one asyncio event loop per DAEMON (under the
+sharded reactor runtime, utils/reactor.py, each daemon's messenger
+binds, accepts, and dispatches wholly on its owning shard's loop —
+connections between daemons on different shards are ordinary localhost
+socket hops, same-shard stays in-loop; a Messenger and its Connections
+are loop-bound objects in the loop-affinity sense and must never be
+driven from another shard without a threadsafe handoff);
+coroutine-per-connection instead of a hand-rolled state machine; the
+banner/HELLO exchange carries JSON instead of dencoded structs.
+Auth: `none` by default, cephx-lite mutual HMAC when
 an auth_key is set; on top of that the handshake can negotiate AES-GCM
 secure mode and/or zlib on-wire compression (frames.Onwire), with the
 negotiation transcript bound into the auth proofs so a MITM cannot
@@ -34,7 +40,7 @@ import time
 from typing import Awaitable, Callable
 
 from ceph_tpu.msg.frames import BANNER, Frame, FrameError, Tag, Onwire
-from ceph_tpu.msg.messages import Message
+from ceph_tpu.msg.messages import Message, _json_seg
 from ceph_tpu.qa import faultinject
 from ceph_tpu.utils import tracer
 from ceph_tpu.utils.async_util import being_cancelled, drain_all, reap, \
@@ -283,7 +289,7 @@ class Connection:
             await self._open_transport(reconnect=False)
             return
         if reply.tag in (Tag.HELLO, Tag.RECONNECT_OK):
-            info = json.loads(reply.segments[0])
+            info = _json_seg(reply.segments[0])
             agreed = info.get("onwire") or {}
             if self.messenger.auth_key is not None:
                 # cephx-lite leg 2: verify the acceptor's proof, then
@@ -456,7 +462,7 @@ class Connection:
                         continue
                 self._dispatch_q.put_nowait((self._session_gen, msg))
             elif frame.tag == Tag.ACK:
-                (seq,) = json.loads(frame.segments[0])
+                (seq,) = _json_seg(frame.segments[0])
                 self._trim_sent(seq)
             elif frame.tag == Tag.KEEPALIVE:
                 self._out.put_nowait(("keepalive_ack", None))
@@ -534,10 +540,14 @@ class Connection:
                 frame = Frame(Tag.KEEPALIVE_ACK, [])
             else:  # pragma: no cover
                 continue
-            blob = frame.encode()
             if onwire is not None:
-                blob = onwire.wrap(blob)
-            writer.write(blob)
+                writer.write(onwire.wrap(frame.encode()))
+            else:
+                # plain crc mode: scatter-write the frame parts — the
+                # transport's outbound join is the single tx copy, and
+                # data segments (zero-copy views from upper layers)
+                # never get assembled into an intermediate blob here
+                writer.writelines(frame.encode_parts())
             await writer.drain()
 
     def _trim_sent(self, acked_seq: int) -> None:
@@ -630,7 +640,7 @@ class Messenger:
             frame = await Frame.read(reader)
             if frame.tag not in (Tag.HELLO, Tag.RECONNECT):
                 raise FrameError(f"bad handshake tag {frame.tag}")
-            info = json.loads(frame.segments[0])
+            info = _json_seg(frame.segments[0])
         except Exception as e:
             dout("ms", 5, f"{self.entity_name} accept failed: {e}")
             writer.close()
@@ -667,7 +677,7 @@ class Messenger:
             try:
                 proof_frame = await asyncio.wait_for(Frame.read(reader),
                                                      10.0)
-                got = json.loads(proof_frame.segments[0])
+                got = _json_seg(proof_frame.segments[0])
             except Exception:
                 writer.close()
                 return False

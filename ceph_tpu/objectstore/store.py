@@ -73,7 +73,16 @@ class Transaction:
 
     def write(self, cid: CollectionId, oid: Ghobject, offset: int,
               data: bytes) -> "Transaction":
-        self.ops.append((Op.WRITE, cid, oid, offset, bytes(data)))
+        # snapshot MUTABLE buffers (bytearray, numpy views): the txn
+        # applies later and must see the bytes as queued. Immutable
+        # payloads — bytes, and the read-only memoryviews the zero-copy
+        # receive path delivers — pass through by reference: bytes()
+        # here silently re-copied every full payload, exactly the copy
+        # the rx discipline removed (and invisibly to the copy ledger).
+        if not isinstance(data, bytes) and \
+                not (isinstance(data, memoryview) and data.readonly):
+            data = bytes(data)
+        self.ops.append((Op.WRITE, cid, oid, offset, data))
         return self
 
     def zero(self, cid: CollectionId, oid: Ghobject, offset: int,

@@ -11,9 +11,14 @@ real systems (arXiv:1709.05365); the cure is the admission-queue /
 continuous-batching discipline of an inference server (arXiv:2108.02692
 uses the same staging shape for XOR-network kernels).
 
-This module is that admission queue, one per event loop (i.e. one per
-vstart-style cluster — every OSD, and any Checksummer caller, in the
-process shares it):
+This module is that admission queue, one front end per event loop —
+one per vstart-style cluster in the single-loop world, one per reactor
+SHARD under the sharded runtime (utils/reactor.py), where the device
+topology, per-chip circuit breakers, and serving mesh are a single
+pool-shared object so every shard sees one rotation decision per chip
+while admission/batching/staging stay loop-local (cross-shard callers
+hand jobs over through `submit_threadsafe`'s call_soon_threadsafe
+handoff):
 
   * submit(): callers hand over an `EncodeJob`/`DecodeJob`/`CrcJob`
     (numpy batch + codec identity) and await a future. Admission is
@@ -92,7 +97,12 @@ _DEFAULTS: dict[str, Any] = {
 
 #: one service per event loop: a loop is one cluster's world (tests and
 #: benches run many clusters through sequential asyncio.run calls, and a
-#: service holds loop-bound primitives)
+#: service holds loop-bound primitives). Under the sharded reactor each
+#: shard's loop gets its own service FRONT END (admission queue,
+#: buckets, staging pools — all loop-bound), while the device topology
+#: (breaker state per chip, serving mesh) is ONE shared object hung off
+#: the reactor pool, so four shards see one rotation decision per chip.
+_instances_lock = threading.Lock()
 _instances: dict[Any, "OffloadService"] = {}
 
 _pool: concurrent.futures.ThreadPoolExecutor | None = None
@@ -111,10 +121,17 @@ def _executor() -> concurrent.futures.ThreadPoolExecutor:
     return _pool
 
 
+_perf_lock = threading.Lock()
+
+
 def _perf():
     coll = PerfCountersCollection.instance()
-    pc = coll.get("offload")
-    if pc is None:
+    with _perf_lock:
+        # shard loops race the first-use registration; the lock also
+        # keeps a second caller from seeing a half-added counter set
+        pc = coll.get("offload")
+        if pc is not None:
+            return pc
         pc = coll.create("offload")
         pc.add("jobs", description="ops submitted to the offload queue")
         pc.add("batches", description="device batches dispatched")
@@ -189,41 +206,191 @@ class _Bucket:
         self.uses_device = uses_device
 
 
-class _DeviceSlot:
-    """One dispatch target: a device, its pipeline semaphore, its
-    reusable staging buffers, and its own circuit-breaker state."""
+class _DeviceState:
+    """Process-shared identity + circuit-breaker state for one
+    accelerator. Under a reactor pool every shard's service holds a
+    slot onto the SAME state, so breaker evidence (which arrives
+    concurrently from every shard loop) feeds one rotation decision
+    per chip; transitions take `lock`."""
 
-    __slots__ = ("label", "jdev", "sem", "depth", "inflight", "staging",
-                 "degraded", "degraded_since", "consec_failures",
-                 "probe_owner", "last_error")
+    __slots__ = ("label", "jdev", "lock", "degraded", "degraded_since",
+                 "consec_failures", "probe_owner", "last_error")
 
-    def __init__(self, label: str, jdev, depth: int):
+    def __init__(self, label: str, jdev):
         self.label = label
         self.jdev = jdev                 # jax device, or None = host lane
-        self.depth = max(1, depth)
-        self.sem = asyncio.Semaphore(self.depth)
-        self.inflight = 0                # batches routed here, not done
-        # pinned-in-spirit staging: reused flat uint8 arrays (the warm
-        # pages the link bench's reused-buffer rate measures); at most
-        # `depth` buffers — the double-buffer pair at depth 2
-        self.staging: list[np.ndarray] = []
+        self.lock = threading.Lock()
         self.degraded = False
         self.degraded_since = 0.0
         self.consec_failures = 0
         # half-open probe claim: the claimant batch's token, or None.
         # Owner-checked (release_probe) so a batch that merely passed
-        # through the slot can never free another batch's claim.
+        # through the device can never free another batch's claim.
         self.probe_owner: object | None = None
         self.last_error = ""
 
+
+class _Topology:
+    """The cross-shard half of the service: device states, the serving
+    mesh, and the mesh breaker. One per reactor pool (shared by every
+    shard's service) or one per unpooled service (the pre-shard
+    behavior, unchanged)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.states: list[_DeviceState] | None = None
+        self.mesh = None
+        self.mesh_fns: dict[tuple, Callable] = {}
+        self.mesh_degraded = False
+        self.mesh_degraded_since = 0.0
+        self.mesh_probe_inflight = False
+
+    def reset(self) -> None:
+        with self.lock:
+            self.states = None
+            self.mesh = None
+            self.mesh_fns.clear()
+            self.mesh_degraded = False
+            self.mesh_probe_inflight = False
+
+    def device_states(self, device_count: int) -> list[_DeviceState]:
+        """Build (once) the shared device list; later callers — other
+        shards' services — reuse it. The expensive half (jax import,
+        device enumeration, mesh build) runs OUTSIDE the lock: shard
+        event loops take this lock synchronously in _mesh_allowed, and
+        holding it across a multi-second backend init would freeze
+        every shard (a racing duplicate build is discarded, which is
+        benign)."""
+        with self.lock:
+            if self.states is not None:
+                return self.states
+        states: list[_DeviceState] = []
+        try:
+            import jax
+            devs = list(jax.devices())
+        except Exception:
+            devs = []
+        if device_count > 0:
+            devs = devs[:device_count]
+        for d in devs:
+            states.append(_DeviceState(f"{d.platform}:{d.id}", d))
+        if not states:
+            states.append(_DeviceState("device:0", None))
+        mesh = None
+        if len(states) >= 2:
+            try:
+                from ceph_tpu.parallel import mesh as mesh_lib
+                # stripe-only serving mesh (see _topology docstring)
+                mesh = mesh_lib.make_mesh(
+                    len(states), stripe=len(states), shard_max=1)
+                dout("offload", 5,
+                     f"offload mesh up: {len(states)} devices, "
+                     f"shape {dict(mesh.shape)}")
+            except Exception as e:
+                dout("offload", 1, f"offload mesh unavailable "
+                                   f"({type(e).__name__}: {e}); "
+                                   f"single-device dispatch only")
+        with self.lock:
+            if self.states is None:       # first finisher publishes
+                self.states = states
+                self.mesh = mesh
+            return self.states
+
+    def mesh_fn(self, cache_key: tuple, M: np.ndarray) -> Callable:
+        """The cached stripe-sharded kernel for matrix `M` — one
+        compile per pool, shared by every shard. The XLA compile runs
+        outside the lock (same reasoning as device_states; a racing
+        double-compile loses to setdefault)."""
+        with self.lock:
+            fn = self.mesh_fns.get(cache_key)
+            mesh = self.mesh
+        if fn is None:
+            from ceph_tpu.parallel import mesh as mesh_lib
+            built = mesh_lib.sharded_apply_fn(mesh, M)
+            with self.lock:
+                fn = self.mesh_fns.setdefault(cache_key, built)
+        return fn
+
+
+class _DeviceSlot:
+    """One shard's dispatch handle onto a device: the per-shard
+    pipeline semaphore and reusable staging buffers (loop-bound, never
+    shared) plus a reference to the cross-shard `_DeviceState` breaker.
+    Breaker fields proxy through so routing/dispatch code (and tests)
+    keep the flat slot API."""
+
+    __slots__ = ("state", "sem", "depth", "inflight", "staging")
+
+    def __init__(self, state: _DeviceState, depth: int):
+        self.state = state
+        self.depth = max(1, depth)
+        self.sem = asyncio.Semaphore(self.depth)
+        self.inflight = 0                # batches routed here, not done
+        # pinned-in-spirit staging: reused flat uint8 arrays (the warm
+        # pages the link bench's reused-buffer rate measures); at most
+        # `depth` buffers — the double-buffer pair at depth 2. Per
+        # SHARD: staging arrays are written on this shard's dispatch
+        # path only, so they never need a lock.
+        self.staging: list[np.ndarray] = []
+
+    @property
+    def label(self) -> str:
+        return self.state.label
+
+    @property
+    def jdev(self):
+        return self.state.jdev
+
+    @property
+    def degraded(self) -> bool:
+        return self.state.degraded
+
+    @degraded.setter
+    def degraded(self, v: bool) -> None:
+        self.state.degraded = v
+
+    @property
+    def degraded_since(self) -> float:
+        return self.state.degraded_since
+
+    @degraded_since.setter
+    def degraded_since(self, v: float) -> None:
+        self.state.degraded_since = v
+
+    @property
+    def consec_failures(self) -> int:
+        return self.state.consec_failures
+
+    @consec_failures.setter
+    def consec_failures(self, v: int) -> None:
+        self.state.consec_failures = v
+
+    @property
+    def probe_owner(self):
+        return self.state.probe_owner
+
+    @probe_owner.setter
+    def probe_owner(self, v) -> None:
+        self.state.probe_owner = v
+
+    @property
+    def last_error(self) -> str:
+        return self.state.last_error
+
+    @last_error.setter
+    def last_error(self, v: str) -> None:
+        self.state.last_error = v
+
     @property
     def probe_inflight(self) -> bool:
-        return self.probe_owner is not None
+        return self.state.probe_owner is not None
 
     def release_probe(self, token) -> None:
         """Release the half-open probe claim IFF `token` owns it."""
-        if token is not None and self.probe_owner is token:
-            self.probe_owner = None
+        state = self.state
+        with state.lock:
+            if token is not None and state.probe_owner is token:
+                state.probe_owner = None
 
     def get_staging(self, nbytes: int) -> np.ndarray:
         best = -1
@@ -282,15 +449,36 @@ class OffloadService:
         self._dev_lock = threading.Lock()
         # dispatch topology (built lazily on first use: importing jax /
         # enumerating devices must not tax service construction on
-        # paths that never touch a device)
+        # paths that never touch a device). The device/breaker/mesh
+        # half lives in `_topo` — ONE shared object across every shard
+        # of a reactor pool, private for unpooled loops — while the
+        # slots (pipeline semaphores + staging pools) stay per shard.
+        # Resolved per ACCESS (the _topo property): services are cached
+        # per loop across ShardPool lifetimes, and a service created
+        # before its loop joined a pool must re-bind to the pool-shared
+        # topology or shard 0 would run a private breaker world.
+        self._topo_pool = None
+        self._topo_obj: _Topology | None = None
         self._slots: list[_DeviceSlot] | None = None
-        self._host_slot = _DeviceSlot("host", None, self.pipeline_depth)
-        self._mesh = None
-        self._mesh_fns: dict[tuple, Callable] = {}
-        self._mesh_degraded = False
-        self._mesh_degraded_since = 0.0
-        self._mesh_probe_inflight = False
+        self._host_slot = _DeviceSlot(_DeviceState("host", None),
+                                      self.pipeline_depth)
         self._last_error = ""
+
+    @property
+    def _topo(self) -> _Topology:
+        try:
+            from ceph_tpu.utils import reactor
+            pool = reactor.pool_for(self._loop)
+        except Exception:
+            pool = None
+        if self._topo_obj is None or pool is not self._topo_pool:
+            self._topo_pool = pool
+            self._topo_obj = pool.shared("offload_topology", _Topology) \
+                if pool is not None else _Topology()
+            # slots reference the previous topology's device states:
+            # rebuild them onto the new one at next dispatch
+            self._slots = None
+        return self._topo_obj
 
     # -- config --------------------------------------------------------------
 
@@ -327,12 +515,11 @@ class OffloadService:
         elif name == "ec_offload_device_count":
             self.device_count = int(value)
             # in-flight batches keep their slot refs; new flushes see
-            # the rebuilt topology
+            # the rebuilt topology (shared reset: the observer applies
+            # the change to every shard's service, each of which drops
+            # its own slot list here)
             self._slots = None
-            self._mesh = None
-            self._mesh_fns.clear()
-            self._mesh_degraded = False
-            self._mesh_probe_inflight = False
+            self._topo.reset()
         elif name == "ec_offload_device_shard_bytes":
             self.device_shard_bytes = int(value)
         elif name == "ec_offload_device_spill_threshold":
@@ -341,47 +528,27 @@ class OffloadService:
     # -- dispatch topology ---------------------------------------------------
 
     def _topology(self) -> list[_DeviceSlot]:
-        """The device slots (built on first use): one per visible
-        accelerator (capped by ec_offload_device_count), plus the mesh
-        for stripe-sharded oversized batches. Without jax — or with no
-        devices — a single anonymous slot dispatches on the caller's
-        default placement, preserving the pre-mesh behavior."""
+        """This shard's device slots (built on first use): one per
+        visible accelerator (capped by ec_offload_device_count), plus
+        the mesh for stripe-sharded oversized batches — the stripe-only
+        serving mesh where every chip does full-rate data-parallel work
+        (the (stripe, shard) shape stays the dryrun/TP-validation
+        config; its shard axis pays an all-gather plus padded parity
+        rows, a net loss at m=3). Device identity/breaker state and the
+        mesh are the SHARED topology; the slot objects (pipeline
+        semaphore, staging pool) are this loop's own. Without jax — or
+        with no devices — a single anonymous slot dispatches on the
+        caller's default placement, preserving the pre-mesh behavior."""
         if self._slots is not None:
             return self._slots
-        slots: list[_DeviceSlot] = []
-        try:
-            import jax
-            devs = list(jax.devices())
-        except Exception:
-            devs = []
-        if self.device_count > 0:
-            devs = devs[: self.device_count]
-        for d in devs:
-            slots.append(_DeviceSlot(f"{d.platform}:{d.id}", d,
-                                     self.pipeline_depth))
-        if not slots:
-            slots.append(_DeviceSlot("device:0", None, self.pipeline_depth))
-        self._slots = slots
-        if len(slots) >= 2:
-            try:
-                from ceph_tpu.parallel import mesh as mesh_lib
-                # stripe-only serving mesh: oversized batches shard on
-                # the stripe (data-parallel) axis, where every chip does
-                # full-rate useful work — the (stripe, shard) 4x2 shape
-                # stays the dryrun/TP-validation config (its shard axis
-                # pays an all-gather plus padded parity rows, a net loss
-                # for throughput at m=3)
-                self._mesh = mesh_lib.make_mesh(
-                    len(slots), stripe=len(slots), shard_max=1)
-                dout("offload", 5,
-                     f"offload mesh up: {len(slots)} devices, shape "
-                     f"{dict(self._mesh.shape)}")
-            except Exception as e:
-                self._mesh = None
-                dout("offload", 1, f"offload mesh unavailable "
-                                   f"({type(e).__name__}: {e}); "
-                                   f"single-device dispatch only")
-        return slots
+        states = self._topo.device_states(self.device_count)
+        self._slots = [_DeviceSlot(st, self.pipeline_depth)
+                       for st in states]
+        return self._slots
+
+    @property
+    def _mesh(self):
+        return self._topo.mesh
 
     def _slot_available(self, slot: _DeviceSlot) -> bool:
         """In rotation: healthy, or cooled down enough for a probe."""
@@ -408,29 +575,45 @@ class OffloadService:
         release_probe on paths where neither ran (cancellation, the
         mesh detour)."""
         slots = self._topology()
-        allowed = [s for s in slots
-                   if self._slot_available(s)
-                   and (exclude is None or s not in exclude)]
-        if not allowed:
-            return None
-        pref = slots[hash(bucket_key) % len(slots)]
-        least = min(allowed, key=lambda s: s.inflight)
-        chosen = least
-        if pref in allowed:
-            if pref.inflight - least.inflight < self.device_spill_threshold:
-                chosen = pref
-            elif least is not pref:
-                # a true load spill: the preferred chip was healthy but
-                # backed up (an unavailable/excluded pref is failover
-                # territory, not a balance signal)
-                self.perf.inc("device_spills")
-                self.stats["device_spills"] += 1
-        if chosen.degraded:
-            # half-open probe claimed (anonymous token when the caller
-            # has none, so the window still admits only one batch)
-            chosen.probe_owner = claimant if claimant is not None \
-                else object()
-        return chosen
+        spill_counted = False
+        while True:
+            allowed = [s for s in slots
+                       if self._slot_available(s)
+                       and (exclude is None or s not in exclude)]
+            if not allowed:
+                return None
+            pref = slots[hash(bucket_key) % len(slots)]
+            least = min(allowed, key=lambda s: s.inflight)
+            chosen = least
+            if pref in allowed:
+                if pref.inflight - least.inflight < \
+                        self.device_spill_threshold:
+                    chosen = pref
+                elif least is not pref and not spill_counted:
+                    # a true load spill: the preferred chip was healthy
+                    # but backed up (an unavailable/excluded pref is
+                    # failover territory, not a balance signal). One
+                    # routing decision = at most one spill, however
+                    # many probe-claim re-route iterations it takes.
+                    spill_counted = True
+                    self.perf.inc("device_spills")
+                    self.stats["device_spills"] += 1
+            if chosen.degraded:
+                # half-open probe claim, ATOMIC across shards (anonymous
+                # token when the caller has none, so the window still
+                # admits only one batch). Losing the claim race to
+                # another shard's batch means the slot just left the
+                # allowed set — re-route around it.
+                state = chosen.state
+                with state.lock:
+                    if state.degraded and state.probe_owner is not None:
+                        exclude = (set() if exclude is None
+                                   else set(exclude)) | {chosen}
+                        continue
+                    if state.degraded:
+                        state.probe_owner = claimant \
+                            if claimant is not None else object()
+            return chosen
 
     # -- public job API ------------------------------------------------------
 
@@ -541,6 +724,21 @@ class OffloadService:
                                   dispatch, dispatch, uses_device=False)
 
     # -- admission -----------------------------------------------------------
+
+    def submit_threadsafe(self, method: str, *args,
+                          **kw) -> concurrent.futures.Future:
+        """Cross-loop submission seam: build one of the public job
+        coroutines (`encode`/`decode`/`crc32c_blocks`/`repair`) and
+        hand it to the owning shard's loop via run_coroutine_threadsafe
+        — the call_soon_threadsafe handoff, packaged. Callers on other
+        shards (or plain threads) get a concurrent Future; awaiting
+        shards wrap it with asyncio.wrap_future. The admission queue,
+        buckets, and staging stay loop-bound — only the HANDOFF crosses
+        threads, which is the whole loop-affinity discipline."""
+        if self._loop.is_closed():
+            raise RuntimeError("offload service's loop is closed")
+        coro = getattr(self, method)(*args, **kw)
+        return asyncio.run_coroutine_threadsafe(coro, self._loop)
 
     async def _submit(self, key: tuple, data: np.ndarray,
                       dispatch: Callable, fallback: Callable,
@@ -879,12 +1077,9 @@ class OffloadService:
                     batch: np.ndarray) -> np.ndarray:
         """Stripe-shard `batch` across the whole mesh through the
         cached sharded kernel for matrix `M` (runs in the staging
-        pool)."""
-        fn = self._mesh_fns.get(cache_key)
-        if fn is None:
-            from ceph_tpu.parallel import mesh as mesh_lib
-            fn = self._mesh_fns[cache_key] = mesh_lib.sharded_apply_fn(
-                self._mesh, M)
+        pool; the kernel cache is pool-shared — one compile serves
+        every shard)."""
+        fn = self._topo.mesh_fn(cache_key, M)
         nbytes = int(batch.nbytes)
         out = fn(batch)
         copytrack.copied("h2d", nbytes)
@@ -892,18 +1087,21 @@ class OffloadService:
         return out
 
     def _mesh_allowed(self) -> bool:
-        if self._mesh is None:
+        topo = self._topo
+        if topo.mesh is None:
             return False
-        if not self._mesh_degraded:
-            return True
-        if (time.monotonic() - self._mesh_degraded_since
-                >= self.breaker_reset_s) and not self._mesh_probe_inflight:
-            # half-open: claim the single probe batch (the claim is
-            # atomic — this runs on the loop); cleared on the probe's
-            # success, failure, or cancellation
-            self._mesh_probe_inflight = True
-            return True
-        return False
+        with topo.lock:
+            if not topo.mesh_degraded:
+                return True
+            if (time.monotonic() - topo.mesh_degraded_since
+                    >= self.breaker_reset_s) and \
+                    not topo.mesh_probe_inflight:
+                # half-open: claim the single probe batch (one claim
+                # ACROSS shards — the lock makes it atomic); cleared on
+                # the probe's success, failure, or cancellation
+                topo.mesh_probe_inflight = True
+                return True
+            return False
 
     async def _dispatch(self, bucket: _Bucket, slot: _DeviceSlot,
                         stacked: np.ndarray, n_ops: int,
@@ -929,15 +1127,17 @@ class OffloadService:
         if (not injected and bucket.shard_dispatch is not None
                 and nbytes >= self.device_shard_bytes
                 and self._mesh_allowed()):
+            topo = self._topo
             try:
                 t0 = time.perf_counter()
                 out = await self._in_staging_pool(
                     lambda b: bucket.shard_dispatch(b), stacked)
                 busy = time.perf_counter() - t0
-                self._mesh_probe_inflight = False
-                if self._mesh_degraded:
-                    self._mesh_degraded = False
-                    dout("offload", 1, "mesh dispatch recovered")
+                with topo.lock:
+                    topo.mesh_probe_inflight = False
+                    if topo.mesh_degraded:
+                        topo.mesh_degraded = False
+                        dout("offload", 1, "mesh dispatch recovered")
                 self.perf.inc("mesh_batches")
                 self.stats["mesh_batches"] += 1
                 self._note_mesh(n_ops, nbytes, busy)
@@ -949,13 +1149,15 @@ class OffloadService:
                 slot.release_probe(token)
                 return out, "mesh"
             except asyncio.CancelledError:
-                self._mesh_probe_inflight = False
+                with topo.lock:
+                    topo.mesh_probe_inflight = False
                 slot.release_probe(token)
                 raise
             except Exception as e:
-                self._mesh_probe_inflight = False
-                self._mesh_degraded = True
-                self._mesh_degraded_since = time.monotonic()
+                with topo.lock:
+                    topo.mesh_probe_inflight = False
+                    topo.mesh_degraded = True
+                    topo.mesh_degraded_since = time.monotonic()
                 self._last_error = f"{type(e).__name__}: {e}"
                 dout("offload", 0,
                      f"mesh dispatch failed ({self._last_error}); "
@@ -1077,27 +1279,37 @@ class OffloadService:
         return all(s.degraded for s in slots)
 
     def _slot_success(self, slot: _DeviceSlot) -> None:
-        # dispatch outcome is breaker evidence: any claim is consumed
-        slot.probe_owner = None
-        slot.consec_failures = 0
-        if slot.degraded:
-            slot.degraded = False
+        state = slot.state
+        recovered = False
+        with state.lock:
+            # dispatch outcome is breaker evidence: any claim is consumed
+            state.probe_owner = None
+            state.consec_failures = 0
+            if state.degraded:
+                state.degraded = False
+                recovered = True
+        if recovered:
             dout("offload", 1,
                  f"device {slot.label} recovered; back in rotation"
                  + ("" if self.degraded else
                     " (TPU_OFFLOAD_DEGRADED clears)"))
 
     def _slot_failure(self, slot: _DeviceSlot, e: Exception) -> None:
-        slot.probe_owner = None
-        slot.consec_failures += 1
-        slot.last_error = f"{type(e).__name__}: {e}"
-        self._last_error = slot.last_error
-        if slot.degraded:
-            slot.degraded_since = time.monotonic()    # probe failed
-            return
-        if slot.consec_failures >= self.breaker_threshold:
-            slot.degraded = True
-            slot.degraded_since = time.monotonic()
+        state = slot.state
+        tripped = False
+        with state.lock:
+            state.probe_owner = None
+            state.consec_failures += 1
+            state.last_error = f"{type(e).__name__}: {e}"
+            self._last_error = state.last_error
+            if state.degraded:
+                state.degraded_since = time.monotonic()   # probe failed
+                return
+            if state.consec_failures >= self.breaker_threshold:
+                state.degraded = True
+                state.degraded_since = time.monotonic()
+                tripped = True
+        if tripped:
             self.perf.inc("breaker_trips")
             self.stats["breaker_trips"] += 1
             dout("offload", 0,
@@ -1151,7 +1363,7 @@ class OffloadService:
             "mesh": {"devices": len(slots),
                      "shape": dict(self._mesh.shape)
                      if self._mesh is not None else None,
-                     "degraded": self._mesh_degraded,
+                     "degraded": self._topo.mesh_degraded,
                      "mesh_batches": s["mesh_batches"]},
             "rotation": {sl.label: {"degraded": sl.degraded,
                                     "inflight": sl.inflight,
@@ -1198,14 +1410,24 @@ def _host_crc(batch: np.ndarray, block_size: int) -> np.ndarray:
 # -- per-loop instance + config plumbing -------------------------------------
 
 def get_service() -> OffloadService:
-    """The running loop's service (created on first use)."""
+    """The running loop's service (created on first use). Thread-safe:
+    under the sharded reactor every shard loop races this on first
+    dispatch."""
     loop = asyncio.get_running_loop()
-    svc = _instances.get(loop)
-    if svc is None:
-        for stale in [lp for lp in _instances if lp.is_closed()]:
-            del _instances[stale]
-        svc = _instances[loop] = OffloadService(loop)
+    with _instances_lock:
+        svc = _instances.get(loop)
+        if svc is None:
+            for stale in [lp for lp in _instances if lp.is_closed()]:
+                del _instances[stale]
+            svc = _instances[loop] = OffloadService(loop)
     return svc
+
+
+def service_for(loop) -> OffloadService | None:
+    """An existing service by loop (no creation) — the lookup a foreign
+    shard or plain thread uses before submit_threadsafe."""
+    with _instances_lock:
+        return _instances.get(loop)
 
 
 def get_service_or_none() -> OffloadService | None:
@@ -1221,7 +1443,9 @@ def get_service_or_none() -> OffloadService | None:
 def set_enabled(flag: bool) -> None:
     """Module-wide toggle (bench harness): defaults + live instances."""
     _DEFAULTS["enabled"] = bool(flag)
-    for svc in _instances.values():
+    with _instances_lock:
+        services = list(_instances.values())
+    for svc in services:
         svc.enabled = bool(flag)
 
 
@@ -1291,7 +1515,11 @@ def register_config(config) -> None:
         key = name[len("ec_offload_"):]
         if key in _DEFAULTS:
             _DEFAULTS[key] = value
-        for svc in _instances.values():
+        # snapshot under the lock: a shard loop's first get_service()
+        # can insert mid-iteration (observers fire on arbitrary threads)
+        with _instances_lock:
+            services = list(_instances.values())
+        for svc in services:
             svc.apply_setting(name, value)
 
     config.add_observer(tuple(names), _on_change)

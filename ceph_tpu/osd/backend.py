@@ -106,6 +106,13 @@ class PGBackend:
                     omap: dict[str, bytes] | None = None) -> None:
         cid = self.coll(shard)
         gh = self.ghobject(oid, shard)
+        if not isinstance(data, (bytes, bytearray)) and \
+                op not in ("write_full", "push", "write"):
+            # control-kind payloads (json / decimal-coded op args)
+            # arrive as zero-copy memoryviews off the wire and their
+            # decoders below need bytes semantics; the BULK kinds above
+            # keep the view — the store writes straight from it
+            data = bytes(data)
         txn = Transaction()
         if op == "write_full":
             # WRITEFULL replaces the DATA only — xattrs and omap survive
@@ -150,7 +157,7 @@ class PGBackend:
                          {"u:" + kv["name"]:
                           kv["value"].encode("latin1")})
         elif op == "rmxattr":
-            name = "u:" + data.decode()
+            name = "u:" + bytes(data).decode()
             try:
                 self.host.store.getattr(cid, gh, name)
             except StoreError:

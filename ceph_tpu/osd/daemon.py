@@ -258,6 +258,8 @@ class OSD(Dispatcher):
         self._hang_until = 0.0
         self._crash_task: asyncio.Task | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
+        # reactor shard index (set at start(); None = unpooled loop)
+        self.shard: int | None = None
         self._booted = asyncio.Event()
         self._hb_task: asyncio.Task | None = None
         self._scrub_task: asyncio.Task | None = None
@@ -287,6 +289,12 @@ class OSD(Dispatcher):
         from ceph_tpu import offload
         self._offload_svc = offload.get_service()
         self._loop = asyncio.get_running_loop()
+        # reactor placement: under the sharded runtime start() runs ON
+        # the owning shard's loop, so every loop-bound resource this
+        # daemon creates (messenger server, connections, op queue,
+        # offload front end) lands on that shard by construction
+        from ceph_tpu.utils import reactor
+        self.shard = reactor.shard_index_of(self._loop)
         sanitizer.maybe_install(self.config)
         loopprof.maybe_install(self.config)
         self.op_queue.start()
@@ -324,6 +332,7 @@ class OSD(Dispatcher):
                 "osdmap_epoch": self.osdmap.epoch,
                 "num_pgs": len(self.pgs),
                 "hb_healthy": self.hb_map.is_healthy()[0],
+                "reactor_shard": self.shard,
                 "ops_processed": self.op_queue.processed}
 
     def _mgr_health_metrics(self) -> dict:

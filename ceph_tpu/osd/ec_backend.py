@@ -120,8 +120,12 @@ class ECBackend(PGBackend):
         pad = (-len(data)) % w
         # already aligned (every full-stripe client write): hand the
         # buffer through untouched — the `data + b""` form copied the
-        # whole payload on the encode hot path
-        return data if not pad else data + b"\x00" * pad
+        # whole payload on the encode hot path. Unaligned tails arrive
+        # as zero-copy memoryviews off the wire; only they pay the
+        # materialize-and-pad.
+        if not pad:
+            return data
+        return bytes(data) + b"\x00" * pad
 
     def _offload_svc(self):
         """The offload service, for DEVICE-batched plugins only: the
@@ -266,6 +270,14 @@ class ECBackend(PGBackend):
 
     async def _execute_write_locked(self, oid: str, op: str, data: bytes,
                                     entry: LogEntry, off: int) -> None:
+        if not isinstance(data, (bytes, bytearray)) and \
+                op not in ("write_full", "push", "write"):
+            # control-kind payloads (json / decimal-coded op args —
+            # setxattr, zero lengths, clone/rollback args) arrive as
+            # zero-copy memoryviews off the wire; their decoders below
+            # need bytes semantics. The bulk kinds keep the view all
+            # the way into the encode batch.
+            data = bytes(data)
         live = self._live_positions()
         if len(live) < self.pg.pool.min_size:
             # the reference blocks the op until min_size is met; our
@@ -315,7 +327,8 @@ class ECBackend(PGBackend):
                             for i in live}
         elif op == "rmxattr":
             payloads = {i: ({"op": "rmxattr",
-                             "name": data.decode()}, b"") for i in live}
+                             "name": bytes(data).decode()}, b"")
+                        for i in live}
         elif op == "zero":
             # same store semantics as the replicated txn.zero here: a
             # ranged write of zeros (extends past the end like a write)
@@ -375,7 +388,8 @@ class ECBackend(PGBackend):
             # chunk data and xattrs already replicate (the reference
             # generates the same per-shard transactions in
             # ECTransaction::generate_transactions for ec pool snaps)
-            payloads = {i: ({"op": op, "args": data.decode("latin1"),
+            payloads = {i: ({"op": op,
+                             "args": bytes(data).decode("latin1"),
                              "version": list(entry.version)}, b"")
                         for i in live}
         else:

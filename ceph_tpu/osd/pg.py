@@ -1075,6 +1075,11 @@ class PGInstance:
         ClassHandler)."""
         from ceph_tpu.cls import ClassCallError, ClassHandler, MethodContext
         from ceph_tpu.cls.registry import CLS_METHOD_WR
+        if not isinstance(data, (bytes, bytearray)):
+            # the registry contract hands cls methods BYTES indata
+            # (they json.loads it); zero-copy rx delivers a memoryview,
+            # and cls inputs are small control blobs — materialize
+            data = bytes(data)
         if op.get("reqid"):
             # a retried CALL whose first execution committed must not
             # re-run the method against post-commit state: its first
@@ -1274,7 +1279,8 @@ class PGInstance:
             op = dict(op, off=op["size"])
         elif kind == "setxattr":
             data = json.dumps({"name": op["name"],
-                               "value": data.decode("latin1")}).encode()
+                               "value": bytes(data).decode("latin1")
+                               }).encode()
         elif kind == "rmxattr":
             data = op["name"].encode()
         elif kind == "omap_set":

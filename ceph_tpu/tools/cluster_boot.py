@@ -9,6 +9,13 @@ the one copy: teardown always runs (even when an OSD fails to start
 mid-loop), always through `bounded_stop`, so a wedged daemon stop is
 cancelled-and-awaited rather than abandoned. Pool/profile creation
 stays with the caller — that is what the call sites actually differ in.
+
+`reactor_shards` dials the sharded reactor runtime (utils/reactor.py):
+with N > 1 the OSDs are placed round-robin across N event-loop shards
+(shard 0 = the calling loop, which keeps the mon and the client — the
+control plane), each OSD's whole lifecycle (start, dispatch, stop)
+running on its owning shard. N = 1 is byte-for-byte the old single-loop
+boot: no pool, no threads.
 """
 from __future__ import annotations
 
@@ -19,18 +26,21 @@ import tempfile
 from typing import AsyncIterator, Callable
 
 from ceph_tpu.utils.async_util import bounded_stop
+from ceph_tpu.utils.reactor import ShardPool
 
 
 @contextlib.asynccontextmanager
 async def ephemeral_cluster(
         n_osds: int, prefix: str = "ceph-tpu-",
         store_factory: Callable[[str, int], object] | None = None,
-        stop_timeout: float = 20.0) -> AsyncIterator[tuple]:
+        stop_timeout: float = 20.0,
+        reactor_shards: int = 1) -> AsyncIterator[tuple]:
     """Boot mon + `n_osds` OSDs on localhost and a connected client;
     yield `(client, osds, mon)`; reap everything on exit.
 
     `store_factory(tmpdir, osd_id)` supplies a per-OSD ObjectStore
-    (None -> MemStore default)."""
+    (None -> MemStore default). `reactor_shards` > 1 spreads the OSDs
+    over that many reactor shards (see module doc)."""
     from ceph_tpu.mon import MonMap, Monitor
     from ceph_tpu.osd.daemon import OSD
     from ceph_tpu.rados import RadosClient
@@ -43,15 +53,29 @@ async def ephemeral_cluster(
     monmap = MonMap({"m0": ("127.0.0.1", port)})
     mon = Monitor("m0", monmap, store_path=f"{tmp}/mon")
     await mon.start()
+    pool = None
     osds: list = []
+    shard_of: dict[int, int] = {}
     client = None
+
+    async def _on_shard(i: int, coro):
+        """Run `coro` on OSD i's shard (inline in the 1-shard world)."""
+        if pool is None:
+            return await coro
+        return await pool.run_on(shard_of[i], coro)
+
     try:
+        # inside the try: a pool that fails to come up must still tear
+        # the already-running mon down
+        if reactor_shards > 1:
+            pool = ShardPool(reactor_shards)
         while not (mon.paxos.is_leader() and mon.paxos.is_active()):
             await asyncio.sleep(0.05)
         for i in range(n_osds):
             store = store_factory(tmp, i) if store_factory else None
             osd = OSD(i, list(monmap.mons.values()), store=store)
-            await osd.start()
+            shard_of[i] = pool.place(i) if pool is not None else 0
+            await _on_shard(i, osd.start())
             osds.append(osd)
         client = RadosClient(list(monmap.mons.values()))
         await client.connect()
@@ -59,6 +83,10 @@ async def ephemeral_cluster(
     finally:
         if client is not None:
             await bounded_stop(client.shutdown(), stop_timeout)
-        for osd in osds:
-            await bounded_stop(osd.stop(), stop_timeout)
+        for i, osd in enumerate(osds):
+            # stop each OSD ON its owning shard: its tasks, queues, and
+            # connections are that loop's objects (loop-affinity rule)
+            await _on_shard(i, bounded_stop(osd.stop(), stop_timeout))
         await bounded_stop(mon.stop(), stop_timeout)
+        if pool is not None:
+            await pool.shutdown()

@@ -227,6 +227,61 @@ def check_await_under_lock(sf: SourceFile) -> list[Finding]:
     return v.findings
 
 
+# -- rule: loop-affinity -----------------------------------------------------
+
+_LOOP_ATTRS = {"_loop", "loop"}
+_LOOP_UNSAFE = {"call_soon", "call_later", "call_at", "create_task"}
+
+
+class _LoopAffinityVisitor(_AsyncScopeVisitor):
+    """Driving ANOTHER object's event-loop handle with a non-threadsafe
+    primitive: `svc._loop.call_soon(...)` / `conn.loop.create_task(...)`
+    where the receiver is not `self`. Under the sharded reactor the
+    other object's loop is routinely a different shard's, and
+    call_soon/create_task from a foreign thread corrupts the loop's
+    ready queue (asyncio only checks with debug mode on). `self._loop.X`
+    stays legal — an object drives its own loop from its own methods —
+    and the threadsafe seams (call_soon_threadsafe,
+    run_coroutine_threadsafe) are exactly what the rule pushes toward."""
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _LOOP_UNSAFE \
+                and isinstance(fn.value, ast.Attribute) \
+                and fn.value.attr in _LOOP_ATTRS:
+            # `self._loop.X` is the object driving its OWN loop (legal);
+            # `self.svc._loop.X` is driving the loop of an object we
+            # merely hold a reference to — foreign, flagged
+            owner = dotted(fn.value.value)
+            if owner is not None and owner != "self":
+                self.report(
+                    node, "loop-affinity",
+                    f"{owner}.{fn.value.attr}.{fn.attr}(...) drives "
+                    f"another object's event loop without the "
+                    f"threadsafe handoff: under the sharded reactor "
+                    f"{owner}'s loop can be a different shard's thread, "
+                    f"and {fn.attr} from a foreign thread corrupts the "
+                    f"loop's ready queue — use "
+                    f"{owner}.{fn.value.attr}.call_soon_threadsafe or "
+                    f"asyncio.run_coroutine_threadsafe")
+        self.generic_visit(node)
+
+
+@rule("loop-affinity", "file",
+      "cross-shard loop discipline (the sharded reactor's lockdep): "
+      "loop-bound objects (OffloadService, Throttle waiters, messenger "
+      "connections) belong to exactly one shard, and scheduling onto "
+      "ANOTHER object's loop handle via call_soon/call_later/call_at/"
+      "create_task is only safe from that loop's own thread. Foreign "
+      "owners must cross through call_soon_threadsafe / "
+      "run_coroutine_threadsafe (or reactor.ShardPool.run_on), which "
+      "are loop-safe from any thread.")
+def check_loop_affinity(sf: SourceFile) -> list[Finding]:
+    v = _LoopAffinityVisitor(sf)
+    v.visit(sf.tree)
+    return v.findings
+
+
 # -- rule: cancellation-swallow ----------------------------------------------
 
 _CANCEL_NAMES = {"BaseException", "CancelledError",

@@ -45,7 +45,7 @@ class VCluster:
 
     def __init__(self, base_dir: str, n_mons: int = 1, n_osds: int = 3,
                  with_mgr: bool = False, with_mds: bool = False,
-                 with_rgw: bool = False):
+                 with_rgw: bool = False, reactor_shards: int = 1):
         ports = free_ports(n_mons)
         self.monmap = MonMap({f"m{i}": ("127.0.0.1", ports[i])
                               for i in range(n_mons)})
@@ -54,6 +54,12 @@ class VCluster:
         self.with_mgr = with_mgr
         self.with_mds = with_mds
         self.with_rgw = with_rgw
+        # sharded reactor: OSDs round-robin across N event-loop shards;
+        # mons, mgr, mds, rgw, and clients stay on shard 0 (the calling
+        # loop). 1 = the classic single-loop cluster, no pool at all.
+        self.reactor_shards = max(1, int(reactor_shards))
+        self.pool = None
+        self._shard_of: dict[int, int] = {}
         self.mons: dict[str, Monitor] = {}
         self.osds: dict[int, OSD] = {}
         self.mgr = None
@@ -66,6 +72,9 @@ class VCluster:
         return list(self.monmap.mons.values())
 
     async def start(self) -> None:
+        if self.reactor_shards > 1:
+            from ceph_tpu.utils.reactor import ShardPool
+            self.pool = ShardPool(self.reactor_shards, name="vstart")
         for name in self.monmap.mons:
             mon = Monitor(name, self.monmap,
                           store_path=f"{self.base_dir}/mon.{name}")
@@ -104,11 +113,20 @@ class VCluster:
     async def start_osd(self, i: int, store=None) -> OSD:
         osd = OSD(i, self.mon_addrs, store=store)
         self.osds[i] = osd
-        await osd.start()
+        if self.pool is not None:
+            shard = self._shard_of.setdefault(i, self.pool.place(i))
+            await self.pool.run_on(shard, osd.start())
+        else:
+            await osd.start()
         return osd
 
     async def kill_osd(self, i: int) -> None:
-        await self.osds.pop(i).stop()
+        osd = self.osds.pop(i)
+        shard = self._shard_of.get(i)
+        if self.pool is not None and shard is not None:
+            await self.pool.run_on(shard, osd.stop())
+        else:
+            await osd.stop()
 
     async def client(self) -> RadosClient:
         c = RadosClient(self.mon_addrs)
@@ -127,10 +145,20 @@ class VCluster:
                 await bounded_stop(daemon.stop(), 20)
         for c in self.clients:
             await bounded_stop(c.shutdown(), 20)
-        for osd in list(self.osds.values()):
-            await bounded_stop(osd.stop(), 20)
+        for i, osd in list(self.osds.items()):
+            shard = self._shard_of.get(i)
+            if self.pool is not None and shard is not None:
+                # stop on the owning shard: the daemon's tasks belong
+                # to that loop (loop-affinity rule)
+                await self.pool.run_on(shard,
+                                       bounded_stop(osd.stop(), 20))
+            else:
+                await bounded_stop(osd.stop(), 20)
         for mon in self.mons.values():
             await bounded_stop(mon.stop(), 20)
+        if self.pool is not None:
+            await self.pool.shutdown()
+            self.pool = None
 
     def status(self) -> dict:
         leader = next((m for m in self.mons.values()
@@ -151,11 +179,12 @@ class VCluster:
         }
 
 
-async def smoke(n_mons: int, n_osds: int) -> dict:
+async def smoke(n_mons: int, n_osds: int, shards: int = 1) -> dict:
     """Boot, write/read through a replicated pool, report. Exit-code
     contract: raises on any failure, returns the status dict on success."""
     with tempfile.TemporaryDirectory(prefix="vstart-") as base:
-        c = VCluster(base, n_mons=n_mons, n_osds=n_osds)
+        c = VCluster(base, n_mons=n_mons, n_osds=n_osds,
+                     reactor_shards=shards)
         try:
             await c.start()
             cl = await c.client()
@@ -202,11 +231,15 @@ def main() -> int:
     p.add_argument("--osds", type=int, default=3)
     p.add_argument("--smoke", action="store_true",
                    help="run a write/read workload and exit")
+    p.add_argument("--shards", type=int, default=1,
+                   help="reactor shards: OSDs round-robin across N "
+                        "event-loop threads (1 = single loop)")
     args = p.parse_args()
     if not args.smoke:
         p.error("only --smoke mode is supported (in-process daemons "
                 "cannot outlive the interpreter)")
-    status = asyncio.run(asyncio.wait_for(smoke(args.mons, args.osds), 120))
+    status = asyncio.run(asyncio.wait_for(
+        smoke(args.mons, args.osds, args.shards), 120))
     print(json.dumps(status, indent=1))
     return 0
 

@@ -78,6 +78,16 @@ class BufferList:
             self._ptrs.append(Ptr(arr))
             self._length += arr.size
             copytrack.referenced("frame_to_buffer", arr.size)
+        elif isinstance(data, memoryview):
+            # zero-copy rx discipline: a frame segment window is adopted
+            # reference-only (frame_rx -> frame_to_buffer without a
+            # bytes() materialization); the recv buffer stays alive via
+            # the view's refcount. Read-only by construction — exactly
+            # like an unowned caller-array window.
+            arr = np.frombuffer(data, dtype=np.uint8)
+            self._ptrs.append(Ptr(arr))
+            self._length += arr.size
+            copytrack.referenced("frame_to_buffer", arr.size)
         else:
             t0 = time.perf_counter()
             arr = np.frombuffer(bytes(data), dtype=np.uint8).copy()

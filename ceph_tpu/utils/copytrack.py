@@ -134,5 +134,8 @@ def perf() -> PerfCounters:
     coll = PerfCountersCollection.instance()
     pc = coll.get("copyflow")
     if pc is None:
-        pc = coll.register(_CopyflowCounters())
+        try:
+            pc = coll.register(_CopyflowCounters())
+        except ValueError:
+            pc = coll.get("copyflow")   # another shard loop won the race
     return pc

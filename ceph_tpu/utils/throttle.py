@@ -35,12 +35,43 @@ class AdjustableSemaphore(asyncio.Semaphore):
         super().__init__(value)
         self._limit = value
         self._debt = 0      # releases to absorb instead of freeing
+        #: the loop the semaphore is bound to, captured at first
+        #: acquire. Under the sharded reactor a release/resize issued
+        #: from another shard's loop (or a plain thread) must NOT touch
+        #: `_value`/`_debt`/the waiter queue directly — they are
+        #: owner-loop state, and a cross-thread mutation corrupts the
+        #: count or wakes a waiter on the wrong loop. Foreign callers
+        #: are marshalled across with call_soon_threadsafe.
+        self._owner_loop: asyncio.AbstractEventLoop | None = None
+
+    async def acquire(self) -> bool:
+        if self._owner_loop is None:
+            self._owner_loop = asyncio.get_running_loop()
+        return await super().acquire()
 
     @property
     def limit(self) -> int:
         return self._limit
 
+    def _foreign_caller(self) -> bool:
+        """True when called off the owning loop (another shard's loop
+        thread, or no loop at all) while the owner is still alive."""
+        owner = self._owner_loop
+        if owner is None or owner.is_closed():
+            return False
+        try:
+            return asyncio.get_running_loop() is not owner
+        except RuntimeError:
+            return True
+
     def resize(self, new_limit: int) -> None:
+        if self._foreign_caller():
+            self._owner_loop.call_soon_threadsafe(self._resize_impl,
+                                                  new_limit)
+            return
+        self._resize_impl(new_limit)
+
+    def _resize_impl(self, new_limit: int) -> None:
         new_limit = max(1, int(new_limit))
         delta = new_limit - self._limit
         self._limit = new_limit
@@ -49,7 +80,7 @@ class AdjustableSemaphore(asyncio.Semaphore):
             pay = min(self._debt, delta)
             self._debt -= pay
             for _ in range(delta - pay):
-                self.release()
+                self._release_impl()
         elif delta < 0:
             shrink = -delta
             take_now = min(self._value, shrink)
@@ -57,6 +88,15 @@ class AdjustableSemaphore(asyncio.Semaphore):
             self._debt += shrink - take_now
 
     def release(self) -> None:
+        if self._foreign_caller():
+            # acquired on shard A, released on shard B: hand the
+            # release to the owning loop whole (count mutation AND
+            # waiter wakeup), so `_value` can never lose an update
+            self._owner_loop.call_soon_threadsafe(self._release_impl)
+            return
+        self._release_impl()
+
+    def _release_impl(self) -> None:
         if self._debt > 0:
             self._debt -= 1     # absorbed: the pool shrank past this slot
             return

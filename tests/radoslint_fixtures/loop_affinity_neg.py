@@ -1,0 +1,28 @@
+"""loop-affinity negatives: every legal way to touch a loop handle."""
+import asyncio
+
+
+class Service:
+    def __init__(self):
+        self._loop = asyncio.new_event_loop()
+
+    def wake(self, fn):
+        # own loop from own methods: same-shard by construction
+        self._loop.call_soon(fn)
+
+    def batch(self, coro):
+        self._loop.create_task(coro)
+
+
+class ForeignCaller:
+    def __init__(self, svc):
+        self.svc = svc
+
+    def submit(self, fn, coro):
+        # the threadsafe seams are exactly what the rule pushes toward
+        self.svc._loop.call_soon_threadsafe(fn)
+        asyncio.run_coroutine_threadsafe(coro, self.svc._loop)
+
+    def local_handle(self, fn):
+        loop = asyncio.get_running_loop()
+        loop.call_soon(fn)              # bare local loop: our own shard

@@ -44,15 +44,25 @@ def test_ledger_frame_tx_rx_exact_bytes():
     segs = [b"a" * 512, b"b" * 256]
     blob = Frame(Tag.MESSAGE, segs).encode()
     snap = copytrack.snapshot()["stages"]
-    # tx copies every segment byte into the wire blob, then bytes()
-    # materializes the blob once more: 2x the segment payload
-    assert snap["frame_tx"]["copied_bytes"] == 2 * 768
+    # tx joins every segment into the wire blob exactly once (the old
+    # assemble-then-bytes() path paid 2x)
+    assert snap["frame_tx"]["copied_bytes"] == 768
     assert snap["frame_tx"]["events"] == 1
-    assert snap["frame_rx"]["copied_bytes"] == 0
-    Frame.decode(blob)
+    # the scatter path (plain crc transport) also meters one copy —
+    # the transport's outbound join — and hands segments by reference
+    parts = Frame(Tag.MESSAGE, segs).encode_parts()
+    assert parts[1] is segs[0] and parts[3] is segs[1]
     snap = copytrack.snapshot()["stages"]
-    # rx slices each segment back out of the blob: 1x the payload
-    assert snap["frame_rx"]["copied_bytes"] == 768
+    assert snap["frame_tx"]["copied_bytes"] == 2 * 768
+    assert snap["frame_rx"]["copied_bytes"] == 0
+    frame = Frame.decode(blob)
+    snap = copytrack.snapshot()["stages"]
+    # rx WINDOWS each segment out of the blob (zero-copy receive): the
+    # payload meters as referenced, and nothing is copied
+    assert snap["frame_rx"]["copied_bytes"] == 0
+    assert snap["frame_rx"]["referenced_bytes"] == 768
+    assert all(isinstance(s, memoryview) for s in frame.segments)
+    assert frame.segments == segs
 
 
 def test_ledger_bufferlist_copy_vs_reference():

@@ -19,7 +19,8 @@ FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "radoslint_fixtures")
 
 ALL_RULES = {"detached-task", "blocking-in-coroutine", "await-under-lock",
-             "cancellation-swallow", "registry-consistency", "decl-use",
+             "cancellation-swallow", "loop-affinity",
+             "registry-consistency", "decl-use",
              "report-export-consistency"}
 
 
@@ -37,6 +38,7 @@ def lint(path, rules):
      "await_under_lock_neg.py"),
     ("cancellation-swallow", "cancellation_swallow_pos.py", 2,
      "cancellation_swallow_neg.py"),
+    ("loop-affinity", "loop_affinity_pos.py", 2, "loop_affinity_neg.py"),
     ("decl-use", "decl_use_bad.py", 5, "decl_use_good.py"),
     ("decl-use", "decl_use_faultinject_bad.py", 2,
      "decl_use_faultinject_good.py"),
