@@ -52,6 +52,7 @@ CLUSTER_TPU_TIMEOUT = 620  # in-situ EC-over-tpu cluster stage: body
 #                            curve (180) + scaling child headroom
 ATTRIBUTION_TIMEOUT = 240  # hermetic attribution-profiler stage
 FAILURE_STORM_TIMEOUT = 320  # kill/revive resilience + repair-ratio stage
+SWARM_TIMEOUT = 320  # 200-client multi-tenant fairness + SLO pipeline stage
 METRIC = "ec_encode_k8m3_1MiB_chunk"
 
 _deadline = time.monotonic() + TOTAL_BUDGET
@@ -194,6 +195,16 @@ def main() -> int:
                       _budget(FAILURE_STORM_TIMEOUT))
     stages["failure_storm"] = storm
 
+    # Stage 6: many-client swarm — >= 200 concurrent librados clients
+    # (mixed sizes, zipfian hot keys, slow-reader overload) against an
+    # EC pool with per-client SLO accounting armed: aggregate MB/s,
+    # per-client p99 spread, fairness ratio (max/median p99), and the
+    # client-observability pipeline verified live (ceph_client_*
+    # scrape + SLO_VIOLATIONS fire/mute). Hermetic: it measures
+    # multi-tenant FAIRNESS, not codec speed.
+    swarm = run_stage("swarm", _hermetic_env(), _budget(SWARM_TIMEOUT))
+    stages["swarm"] = swarm
+
     detail = {k: v for k, v in cpu.items()
               if k not in ("status", "elapsed_s", "stderr_tail")}
     detail.update({k: v for k, v in cluster.items()
@@ -205,6 +216,8 @@ def main() -> int:
                    if k not in ("status", "elapsed_s", "stderr_tail",
                                 "attribution")})
     detail.update({k: v for k, v in storm.items()
+                   if k not in ("status", "elapsed_s", "stderr_tail")})
+    detail.update({k: v for k, v in swarm.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})
     detail.update({k: v for k, v in device.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})

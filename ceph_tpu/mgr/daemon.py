@@ -50,7 +50,7 @@ class DaemonState:
 
     __slots__ = ("name", "service", "schema", "counters", "status",
                  "health_metrics", "progress", "device_metrics",
-                 "last_report_mono", "reports")
+                 "client_metrics", "last_report_mono", "reports")
 
     def __init__(self, name: str, service: str):
         self.name = name
@@ -61,12 +61,31 @@ class DaemonState:
         self.health_metrics: dict = {}
         self.progress: list = []
         self.device_metrics: dict = {}
+        self.client_metrics: dict = {}
         self.last_report_mono = time.monotonic()
         self.reports = 0
 
     @property
     def age(self) -> float:
         return time.monotonic() - self.last_report_mono
+
+
+def _bucket_quantile_ms(buckets: dict[int, int], q: float) -> float:
+    """Quantile upper bound (ms) from power-of-two µs buckets: the
+    smallest bucket bound below which >= q of the samples fall. Bucket
+    exp i counts latencies in [2^i, 2^(i+1)) µs, so the bound quoted
+    is 2^(i+1) µs — the same `le` edge the exporter's cumulative
+    histograms use."""
+    total = sum(buckets.values())
+    if not total:
+        return 0.0
+    want = q * total
+    cum = 0
+    for exp in sorted(buckets):
+        cum += buckets[exp]
+        if cum >= want:
+            return round(2 ** (exp + 1) / 1e3, 3)
+    return round(2 ** (max(buckets) + 1) / 1e3, 3)
 
 
 class DaemonStateIndex:
@@ -108,6 +127,8 @@ class DaemonStateIndex:
         st.progress = payload.get("progress") or []
         dm = payload.get("device_metrics")
         st.device_metrics = dm if isinstance(dm, dict) else {}
+        cm = payload.get("client_metrics")
+        st.client_metrics = cm if isinstance(cm, dict) else {}
         st.last_report_mono = time.monotonic()
         st.reports += 1
         return st
@@ -131,6 +152,54 @@ class DaemonStateIndex:
         return [(name, st.device_metrics)
                 for name, st in sorted(self.daemons.items())
                 if st.device_metrics]
+
+    def client_sources(self) -> list[tuple[str, dict]]:
+        """(daemon, {client: tallies}) pairs — one per reporting OSD."""
+        return [(name, st.client_metrics)
+                for name, st in sorted(self.daemons.items())
+                if st.client_metrics]
+
+    #: numeric per-client fields summed in the cross-OSD merge
+    _CLIENT_SUM_FIELDS = ("ops", "read_ops", "write_ops", "read_bytes",
+                          "written_bytes", "in_flight", "slo_good",
+                          "slo_violations")
+
+    def client_aggregate(self) -> dict[str, dict]:
+        """Cross-OSD merge per client: a client's ops land on every
+        primary it talks to, so its cluster-wide ledger is the SUM of
+        each OSD's tallies, and its latency distribution is the merged
+        histogram (power-of-two µs buckets add bucket-wise). p99 comes
+        from the merged buckets — an honest cluster-wide percentile,
+        not a max-of-maxes."""
+        agg: dict[str, dict] = {}
+        for _daemon, cm in self.client_sources():
+            for client, d in cm.items():
+                if not isinstance(d, dict):
+                    continue
+                e = agg.setdefault(str(client), {
+                    "tenant": None,
+                    **{f: 0 for f in self._CLIENT_SUM_FIELDS},
+                    "read_buckets": {}, "write_buckets": {}})
+                if d.get("tenant") and not e["tenant"]:
+                    e["tenant"] = str(d["tenant"])
+                for f in self._CLIENT_SUM_FIELDS:
+                    v = d.get(f)
+                    if isinstance(v, (int, float)) and \
+                            not isinstance(v, bool):
+                        e[f] += v
+                for side in ("read_buckets", "write_buckets"):
+                    for b, n in (d.get(side) or {}).items():
+                        try:
+                            b, n = int(b), int(n)
+                        except (TypeError, ValueError):
+                            continue
+                        e[side][b] = e[side].get(b, 0) + n
+        for e in agg.values():
+            e["read_lat_p99_ms"] = _bucket_quantile_ms(
+                e.pop("read_buckets"), 0.99)
+            e["write_lat_p99_ms"] = _bucket_quantile_ms(
+                e.pop("write_buckets"), 0.99)
+        return agg
 
     def report_ages(self) -> dict[str, float]:
         return {name: round(st.age, 3)
@@ -172,8 +241,16 @@ class MgrDaemon(Dispatcher):
     def __init__(self, mon_addrs, modules: list[MgrModule] | None = None,
                  auth_key: bytes | None = None,
                  exporter_port: int | None = 0,
-                 name: str = "x"):
+                 name: str = "x", config=None):
         self.name = name
+        from ceph_tpu.utils.config import Config, Option
+        # mgr-side knobs (hot: the exporter re-reads per scrape)
+        self.config = config if config is not None else Config([
+            Option("mgr_max_client_series", "int", 64,
+                   "cap on distinct ceph_client label values in "
+                   "/metrics; overflow folds into ceph_client=\"_other\" "
+                   "so a many-client swarm cannot explode the scrape",
+                   minimum=2)])
         self.messenger = Messenger(f"mgr.{name}", auth_key=auth_key)
         self.messenger.add_dispatcher(self)
         self.monc = MonClient(self.messenger, mon_addrs)
@@ -213,10 +290,17 @@ class MgrDaemon(Dispatcher):
                 status["daemon_reports"] = self.daemon_index.summary()
                 status["progress_events"] = \
                     self.daemon_index.progress_events()
+                # top clients for the dashboard table (cross-OSD merge)
+                agg = self.daemon_index.client_aggregate()
+                status["client_table"] = dict(sorted(
+                    agg.items(),
+                    key=lambda kv: -kv[1].get("ops", 0))[:15])
                 return status
             self.exporter = MetricsExporter(
                 port=self._exporter_port, health_cb=health_cb,
-                status_cb=status_cb, index=self.daemon_index)
+                status_cb=status_cb, index=self.daemon_index,
+                max_client_series=lambda: self.config.get(
+                    "mgr_max_client_series"))
             await self.exporter.start()
         self._tick_task = asyncio.get_running_loop().create_task(
             self._tick_loop())
@@ -323,6 +407,10 @@ class MgrDaemon(Dispatcher):
         nearfull, full = [], []
         offload_degraded = []
         crashed = []
+        # per-client SLO surface (OpTracker ClientTable health metrics)
+        slo_total = 0
+        slo_clients: dict[str, int] = {}
+        slow_clients: dict[str, dict] = {}
         # the mgr's own crash records never travel a report session
         # (it does not report to itself): consult the local registry so
         # a crash-looping mgr module raises RECENT_CRASH too
@@ -349,6 +437,20 @@ class MgrDaemon(Dispatcher):
             if off.get("degraded"):
                 offload_degraded.append(
                     (name, off.get("last_error") or "device error"))
+            cl = hm.get("clients") or {}
+            if cl.get("recent_violations"):
+                slo_total += int(cl["recent_violations"])
+                for v in cl.get("violating_clients") or []:
+                    c = str(v.get("client", "?"))
+                    slo_clients[c] = slo_clients.get(c, 0) \
+                        + int(v.get("recent") or 0)
+            for s in cl.get("slow_clients") or []:
+                c = str(s.get("client", "?"))
+                # a client slow on ANY osd is slow; keep its worst p99
+                cur = slow_clients.get(c)
+                if cur is None or float(s.get("p99_ms") or 0.0) \
+                        > float(cur.get("p99_ms") or 0.0):
+                    slow_clients[c] = dict(s, osd=name)
             store = hm.get("store") or {}
             util = float(store.get("utilization") or 0.0)
             if util >= self.FULL_RATIO:
@@ -396,6 +498,29 @@ class MgrDaemon(Dispatcher):
                            f"(crash ls / crash archive)",
                 "detail": [f"{d}: {n} unarchived crash records"
                            for d, n in crashed]}
+        if slo_total:
+            # recent (windowed) violations only: the check clears by
+            # itself once the overload that caused them ends
+            worst = sorted(slo_clients.items(), key=lambda kv: -kv[1])
+            checks["SLO_VIOLATIONS"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{slo_total} client SLO violations in the "
+                           f"last 30s across {len(slo_clients)} "
+                           f"clients (slo_read_ms/slo_write_ms)",
+                "detail": [f"{c}: {n} recent violations"
+                           for c, n in worst[:10]]}
+        if slow_clients:
+            # a client whose rolling p99 sits FAR beyond the SLO is a
+            # tail-latency outlier even when total violations are few —
+            # the starved-tenant signal a QoS scheduler must fix
+            checks["SLOW_CLIENT"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(slow_clients)} clients with p99 far "
+                           f"over SLO",
+                "detail": [f"{c}: {s.get('kind')} p99 "
+                           f"{s.get('p99_ms')}ms vs slo "
+                           f"{s.get('slo_ms')}ms on {s.get('osd')}"
+                           for c, s in sorted(slow_clients.items())]}
         if offload_degraded:
             # the EC data path still serves (host-codec fallback is
             # bit-identical) but at host speed: warn, don't err

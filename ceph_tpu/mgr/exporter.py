@@ -29,6 +29,56 @@ from ceph_tpu.utils.perf_counters import PerfCountersCollection
 
 _SEVERITY = {"HEALTH_OK": 0, "HEALTH_WARN": 1, "HEALTH_ERR": 2}
 
+#: default bound on distinct `ceph_client` label values per scrape
+#: (mgr_max_client_series): a 500-client swarm must not turn /metrics
+#: into a cardinality bomb — overflow folds into ceph_client="_other"
+MAX_CLIENT_SERIES = 64
+
+#: (field, prometheus type, fold) — the ceph_client_* family table.
+#: fold "sum" for ledgers, "max" for the percentile gauges (a folded
+#: row's p99 is the worst of its members, never their meaningless sum)
+_CLIENT_FAMILIES = (
+    ("ops", "counter", "sum"),
+    ("read_ops", "counter", "sum"),
+    ("write_ops", "counter", "sum"),
+    ("read_bytes", "counter", "sum"),
+    ("written_bytes", "counter", "sum"),
+    ("in_flight", "gauge", "sum"),
+    ("slo_good", "counter", "sum"),
+    ("slo_violations", "counter", "sum"),
+    ("read_lat_p99_ms", "gauge", "max"),
+    ("write_lat_p99_ms", "gauge", "max"),
+)
+
+
+def _cap_client_series(agg: dict[str, dict], cap: int) -> dict[str, dict]:
+    """Bound the client set at `cap` distinct label values: the top
+    (cap-1) clients by ops keep their own rows, everyone else (plus any
+    OSD-side fold row) merges into one `_other`."""
+    if len(agg) <= cap:
+        return agg
+    overflow = [c for c in agg if c != "_other"]
+    ranked = sorted(overflow, key=lambda c: (-agg[c].get("ops", 0), c))
+    keep = ranked[:max(1, cap - 1)]
+    out = {c: agg[c] for c in keep}
+    other = {"tenant": None,
+             **{f: 0 for f, _t, fold in _CLIENT_FAMILIES if fold == "sum"},
+             **{f: 0.0 for f, _t, fold in _CLIENT_FAMILIES
+                if fold == "max"}}
+    folded = 0
+    for c, e in agg.items():
+        if c in out:
+            continue
+        folded += 1
+        for f, _t, fold in _CLIENT_FAMILIES:
+            v = e.get(f)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            other[f] = max(other[f], v) if fold == "max" else other[f] + v
+    if folded:
+        out["_other"] = other
+    return out
+
 
 def _sanitize(name: str) -> str:
     """Metric-NAME sanitizer: prometheus names are [a-z0-9_] here (the
@@ -75,9 +125,12 @@ def _render_value(metric: str, label: str, ctype: str | None,
             "gauge" if ctype == "gauge" else "counter")
 
 
-def render_metrics(health: dict | None = None, index=None) -> str:
+def render_metrics(health: dict | None = None, index=None,
+                   max_client_series: int | None = None) -> str:
     """The /metrics payload: aggregated per-daemon counters (or the
     local registry when no daemon reports exist), text format."""
+    if max_client_series is None:
+        max_client_series = MAX_CLIENT_SERIES
     sources: list[tuple[str, dict, dict]] = \
         index.render_sources() if index is not None else []
     from_reports = bool(sources)
@@ -125,6 +178,24 @@ def render_metrics(health: dict | None = None, index=None) -> str:
                     fam["rows"].append(
                         f'{metric}{{ceph_daemon="{dlabel}",'
                         f'ceph_device="{vlabel}"}} {value}')
+        # per-client labeled families (the multi-tenant lens): one row
+        # per client per family, merged ACROSS OSDs by the index, label
+        # cardinality bounded by mgr_max_client_series with overflow
+        # folded into ceph_client="_other"
+        agg = _cap_client_series(index.client_aggregate(),
+                                 int(max_client_series))
+        for client, e in sorted(agg.items()):
+            clabel = (f'ceph_client="{_label_escape(str(client))}",'
+                      f'tenant="{_label_escape(str(e.get("tenant") or ""))}"')
+            for field, ftype, _fold in _CLIENT_FAMILIES:
+                v = e.get(field)
+                if not isinstance(v, (int, float)) or \
+                        isinstance(v, bool):
+                    continue
+                metric = f"ceph_client_{_sanitize(field)}"
+                fam = families.setdefault(
+                    metric, {"type": ftype, "rows": []})
+                fam["rows"].append(f"{metric}{{{clabel}}} {v}")
         fam = families.setdefault("ceph_daemon_report_age_seconds",
                                   {"type": "gauge", "rows": []})
         for daemon, age in index.report_ages().items():
@@ -198,6 +269,26 @@ def render_dashboard(status: dict, health: dict | None) -> str:
                     + "".join(daemon_rows) + "</table>"
                     if daemon_rows else
                     "<h2>daemons</h2><p>no daemon reports yet</p>")
+    # per-client table (the multi-tenant lens): top clients by ops with
+    # their byte ledgers, tail latency, and SLO score
+    client_rows = []
+    for cname, ce in sorted((status.get("client_table") or {}).items(),
+                            key=lambda kv: -kv[1].get("ops", 0)):
+        client_rows.append(
+            f"<tr><td>{esc(str(cname))}</td>"
+            f"<td>{esc(str(ce.get('tenant') or ''))}</td>"
+            f"<td>{esc(str(ce.get('ops', 0)))}</td>"
+            f"<td>{ce.get('read_bytes', 0) / 1e6:.1f}</td>"
+            f"<td>{ce.get('written_bytes', 0) / 1e6:.1f}</td>"
+            f"<td>{esc(str(ce.get('read_lat_p99_ms', 0)))}</td>"
+            f"<td>{esc(str(ce.get('write_lat_p99_ms', 0)))}</td>"
+            f"<td>{esc(str(ce.get('slo_violations', 0)))}</td></tr>")
+    clients_html = ("<h2>clients</h2><table><tr><th>client</th>"
+                    "<th>tenant</th><th>ops</th><th>read MB</th>"
+                    "<th>written MB</th><th>read p99 (ms)</th>"
+                    "<th>write p99 (ms)</th><th>SLO viol</th></tr>"
+                    + "".join(client_rows) + "</table>"
+                    if client_rows else "")
     progress_items = []
     for ev in (status.get("progress_events")
                or status.get("progress") or []):
@@ -238,6 +329,7 @@ mons {', '.join(str(q) for q in
 <table><tr><th>pool</th><th>type</th><th>size</th><th>pg_num</th></tr>
 {''.join(rows)}</table>
 {daemons_html}
+{clients_html}
 {progress_html}
 {traces_html}
 <h2>mgr modules</h2><pre>{mods}</pre>
@@ -254,15 +346,26 @@ class MetricsExporter:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  health_cb: Callable[[], Awaitable[dict]] | None = None,
                  status_cb: Callable[[], Awaitable[dict]] | None = None,
-                 index=None):
+                 index=None, max_client_series=None):
         self.host, self.port = host, port
         self.health_cb = health_cb
         self.status_cb = status_cb
         # the mgr's DaemonStateIndex: aggregated per-daemon counters
         # from MMgrReport sessions (None -> local-registry fallback)
         self.index = index
+        # int or zero-arg callable (hot mgr_max_client_series read)
+        self.max_client_series = max_client_series
         self._server: asyncio.Server | None = None
         self.addr: tuple[str, int] | None = None
+
+    def _client_series_cap(self) -> int:
+        cap = self.max_client_series
+        if callable(cap):
+            try:
+                cap = cap()
+            except Exception:
+                cap = None
+        return int(cap) if cap else MAX_CLIENT_SERIES
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
@@ -303,7 +406,9 @@ class MetricsExporter:
                 except Exception as e:
                     dout("mgr", 2, f"health callback failed: {e}")
             if path.startswith("/metrics"):
-                body = render_metrics(health, index=self.index).encode()
+                body = render_metrics(
+                    health, index=self.index,
+                    max_client_series=self._client_series_cap()).encode()
                 ctype = "text/plain; version=0.0.4"
                 code = "200 OK"
             elif path.startswith("/health"):

@@ -45,6 +45,7 @@ class MgrClient(Dispatcher):
                  health_cb: Callable[[], dict] | None = None,
                  progress_cb: Callable[[], list] | None = None,
                  device_cb: Callable[[], dict] | None = None,
+                 client_cb: Callable[[], dict] | None = None,
                  perf_name: str | None = None,
                  extra_loggers: tuple[str, ...] = ()):
         self.messenger = messenger
@@ -59,6 +60,10 @@ class MgrClient(Dispatcher):
         # per-accelerator utilization): {device: {counter: value}},
         # exported with a `ceph_device` label alongside `ceph_daemon`
         self.device_cb = device_cb
+        # per-client labeled metrics (the OSD OpTracker's ClientTable):
+        # {client: {counter/buckets}}, merged ACROSS daemons in the mgr
+        # and exported as ceph_client_* with a `ceph_client` label
+        self.client_cb = client_cb
         self.perf_name = perf_name or daemon_name
         # process-shared perf loggers this daemon also reports (e.g. the
         # EC offload service's "offload" counters), merged into the
@@ -171,6 +176,7 @@ class MgrClient(Dispatcher):
         payload["health_metrics"] = self._safe(self.health_cb, {})
         payload["progress"] = self._safe(self.progress_cb, [])
         payload["device_metrics"] = self._safe(self.device_cb, {})
+        payload["client_metrics"] = self._safe(self.client_cb, {})
         conn.send_message(MMgrReport(payload))
         self.reports_sent += 1
         return True

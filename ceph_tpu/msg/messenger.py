@@ -131,6 +131,7 @@ class Connection:
         self.messenger = messenger
         self.peer_addr = peer_addr          # (host, port) for initiators
         self.peer_name = ""                 # entity name from HELLO
+        self.peer_tenant = None             # optional tenant label (HELLO)
         self.policy = policy
         self.initiator = initiator
         self.cookie = int.from_bytes(os.urandom(8), "little") if initiator else 0
@@ -246,6 +247,12 @@ class Connection:
             "reconnect": reconnect,
             "lossy": self.policy.lossy,
         }
+        if self.messenger.tenant:
+            # client identity plane: the tenant label is negotiated ONCE
+            # per session here (alongside the entity name) — per-op
+            # stamps on MOSDOp are cross-checked against it, never
+            # trusted on their own
+            hello["tenant"] = self.messenger.tenant
         my_nonce = None
         if self.messenger.auth_key is not None:
             my_nonce = os.urandom(16).hex()
@@ -576,8 +583,14 @@ class Messenger:
 
     def __init__(self, entity_name: str, auth_key: bytes | None = None,
                  compress: bool | None = None,
-                 secure: bool | None = None):
+                 secure: bool | None = None,
+                 tenant: str | None = None):
         self.entity_name = entity_name
+        # optional multi-tenant label carried in every outgoing HELLO:
+        # the OSD's per-client accountant groups `client.<id>` entities
+        # under it (the reference's rados namespace/auth-entity axis,
+        # collapsed to one advisory string)
+        self.tenant = tenant
         # negotiated on-wire modes (ProtocolV2 secure mode + on-wire
         # compression): both sides must want a mode for it to engage;
         # secure additionally requires the cephx-lite shared key
@@ -714,6 +727,11 @@ class Messenger:
             if not await _auth_verify(expect):
                 return
             await conn._close_transport()
+            # re-assert the session identity: the entity name is fixed
+            # by the (entity, cookie) session key, but a restarted
+            # client process may re-tag its tenant
+            if "tenant" in info:
+                conn.peer_tenant = info.get("tenant")
             conn._requeue_for_replay(peer_in_seq)
             conn._onwire = _build_onwire(
                 agreed, role="srv", auth_key=self.auth_key,
@@ -725,6 +743,7 @@ class Messenger:
         policy = Policy(lossy=bool(info.get("lossy", True)))
         conn = Connection(self, None, policy, initiator=False)
         conn.peer_name = info["entity"]
+        conn.peer_tenant = info.get("tenant")
         conn.cookie = info.get("cookie", 0)
         reply = {"entity": self.entity_name, "in_seq": 0}
         agreed = self._negotiate_onwire(info)

@@ -42,7 +42,9 @@ from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import (TYPE_AVG, TYPE_HISTOGRAM,
                                           PerfCountersCollection)
 from ceph_tpu.utils.throttle import AdjustableSemaphore, HeartbeatMap
-from ceph_tpu.utils.work_queue import (Finisher, OpTracker, ShardedOpQueue,
+from ceph_tpu.utils.work_queue import (ClientTable, Finisher, OpTracker,
+                                       ShardedOpQueue, WRITE_OP_KINDS,
+                                       classify_ops, current_op,
                                        reset_current_op, set_current_op)
 
 
@@ -93,6 +95,19 @@ class OSD(Dispatcher):
                    "use regenerating-code sub-chunk repair plans for "
                    "single-shard recovery (fetch repair fragments from "
                    "d helpers instead of k whole chunks)"),
+            # per-client SLO engine (hot: the observer pushes changes
+            # into the live ClientTable, so an operator can tighten or
+            # relax the SLO mid-overload). 0 = class unguarded.
+            Option("slo_read_ms", "float", 0.0,
+                   "read-op SLO in ms; ops slower than this count as "
+                   "per-client violations (0 disables)", minimum=0.0),
+            Option("slo_write_ms", "float", 0.0,
+                   "write-op SLO in ms; ops slower than this count as "
+                   "per-client violations (0 disables)", minimum=0.0),
+            Option("osd_max_client_entries", "int", 256,
+                   "bound of the per-client accounting table; the "
+                   "least-recently-active overflow folds into _other "
+                   "(hot: resizes the live table)", minimum=2),
         ])
         # op tracing rides the same config (hot-togglable: `config set
         # tracer_enabled true` over the admin socket starts collecting)
@@ -158,7 +173,20 @@ class OSD(Dispatcher):
         # op execution substrate: sharded queue (per-PG order, cross-PG
         # concurrency) + finisher for completions + per-op tracking
         self.hb_map = HeartbeatMap()
-        self.optracker = OpTracker()
+        # the per-client accountant registers in the process collection
+        # so admin-socket `perf dump`/`perf reset` cover it (reset
+        # zeroes the client tables, not just the aggregate counters)
+        clients = ClientTable(
+            f"osd.{whoami}.clients",
+            max_entries=self.config.get("osd_max_client_entries"))
+        clients.set_slo(read_ms=self.config.get("slo_read_ms"),
+                        write_ms=self.config.get("slo_write_ms"))
+        coll.remove(clients.name)       # a restarted id re-registers
+        coll.register(clients)
+        self.config.add_observer(
+            ("slo_read_ms", "slo_write_ms", "osd_max_client_entries"),
+            self._on_client_knobs)
+        self.optracker = OpTracker(clients=clients)
         self.op_queue = ShardedOpQueue(
             f"osd.{whoami}.op_tp",
             num_shards=self.config.get("osd_op_num_shards"),
@@ -180,6 +208,12 @@ class OSD(Dispatcher):
                 "dump_historic_slow_ops",
                 lambda req: self.optracker.dump_historic_slow_ops(),
                 "recently completed slow ops")
+            self.asok.register_command(
+                "dump_clients",
+                lambda req: self.optracker.clients.dump_clients(
+                    req.get("limit")),
+                "per-client accounting: ops/bytes/in-flight, rolling "
+                "p50/p99 per class, SLO good-vs-violating counters")
             self.asok.register_command(
                 "scrub",
                 lambda req: self._trigger_scrub(req.get("deep", False)),
@@ -221,6 +255,7 @@ class OSD(Dispatcher):
             health_cb=self._mgr_health_metrics,
             progress_cb=self._mgr_progress,
             device_cb=self._mgr_device_metrics,
+            client_cb=self._mgr_client_metrics,
             extra_loggers=("offload", "sanitizer", "loopprof",
                            "copyflow"))
         # the per-loop offload service handle (set at start(): the
@@ -363,6 +398,9 @@ class OSD(Dispatcher):
                 # a degraded service into TPU_OFFLOAD_DEGRADED
                 "offload": (self._offload_svc.health_metrics()
                             if self._offload_svc is not None else {}),
+                # per-client SLO surface: recent violations + slow
+                # clients, digested into SLO_VIOLATIONS / SLOW_CLIENT
+                "clients": self.optracker.clients.health_metrics(),
                 "store": self.store.statfs()}
 
     def _mgr_device_metrics(self) -> dict:
@@ -371,6 +409,24 @@ class OSD(Dispatcher):
         `ceph_device` label."""
         return (self._offload_svc.device_metrics()
                 if self._offload_svc is not None else {})
+
+    def _mgr_client_metrics(self) -> dict:
+        """Per-client accounting for the report path: the mgr merges a
+        client's tallies ACROSS OSDs and the exporter renders them as
+        `ceph_client_*` families with a `ceph_client` label."""
+        return self.optracker.clients.mgr_metrics()
+
+    def _on_client_knobs(self, name: str, value) -> None:
+        """slo_read_ms / slo_write_ms / osd_max_client_entries observer:
+        pushed straight into the live ClientTable (its own lock makes
+        this safe from the admin-socket thread)."""
+        clients = self.optracker.clients
+        if name == "slo_read_ms":
+            clients.set_slo(read_ms=float(value))
+        elif name == "slo_write_ms":
+            clients.set_slo(write_ms=float(value))
+        elif name == "osd_max_client_entries":
+            clients.resize(int(value))
 
     def _offload_admin(self, cmd: str) -> dict:
         if self._offload_svc is None:
@@ -919,6 +975,22 @@ class OSD(Dispatcher):
     # (src/osd/OSD.cc:9683 enqueue_op, :9742 dequeue_op; per-PG hashing
     # keeps same-PG ops FIFO while shards run concurrently)
 
+    @staticmethod
+    def _op_identity(conn: Connection,
+                     p: dict) -> tuple[str | None, str | None]:
+        """Client identity of an op: the session's handshake entity is
+        authoritative (it was negotiated before any op flowed); the
+        MOSDOp stamp is the fallback for paths where the originating
+        session is gone (requeues after a reset). Non-client peers
+        (OSD-to-OSD MOSDOp never happens, but belt-and-braces) are not
+        accounted."""
+        name = conn.peer_name if conn.peer_name.startswith("client") \
+            else p.get("client")
+        if not name or not str(name).startswith("client"):
+            return None, None
+        tenant = getattr(conn, "peer_tenant", None) or p.get("tenant")
+        return str(name), (str(tenant) if tenant else None)
+
     def _ingest_op(self, conn: Connection, msg: MOSDOp) -> None:
         p = msg.payload
         pool_id, ps = p["pgid"]
@@ -930,6 +1002,7 @@ class OSD(Dispatcher):
                  "epoch": self.osdmap.epoch, "error": "not primary"}))
             return
         ops = p.get("ops", [])
+        client, tenant = self._op_identity(conn, p)
         desc = (f"osd_op({'+'.join(o.get('op', '?') for o in ops)} "
                 f"{ops[0].get('oid', '') if ops else ''} "
                 f"pg={pgid.pool}.{pgid.ps} tid={p.get('tid', 0)})")
@@ -939,7 +1012,8 @@ class OSD(Dispatcher):
             # PG (the RBD header-watch pattern) deadlocks behind it —
             # the reference routes notifies outside the write pipeline.
             # Still tracked + counted like any other op.
-            trk = self.optracker.create(desc)
+            trk = self.optracker.create(desc, client=client,
+                                        tenant=tenant)
             trk.trace = tracer.current_context()
             trk.mark_event("detached_notify")
             t = asyncio.get_running_loop().create_task(
@@ -947,7 +1021,7 @@ class OSD(Dispatcher):
             self._notify_tasks.add(t)
             t.add_done_callback(self._notify_tasks.discard)
             return
-        trk = self.optracker.create(desc)
+        trk = self.optracker.create(desc, client=client, tenant=tenant)
         # the trace context (the connection's ms_dispatch span) rides the
         # TrackedOp: the queued closure runs in a shard worker task where
         # the dispatch context is gone
@@ -1041,6 +1115,11 @@ class OSD(Dispatcher):
                 {"tid": tid, "rc": -11, "epoch": self.osdmap.epoch,
                  "error": "not primary"}))
             return
+        trk = current_op()
+        if trk is not None and trk.client:
+            # kind is known before execution so even an errored op's
+            # latency lands in the right per-client histogram
+            trk.kind = classify_ops(p.get("ops", []))
         try:
             results = []
             outdata = b""
@@ -1055,6 +1134,20 @@ class OSD(Dispatcher):
                 if rc < 0:
                     break
             final_rc = results[-1]["rc"] if results else 0
+            if trk is not None and trk.client:
+                # byte attribution: reads are charged what they
+                # returned; writes what they shipped — but a dup-op
+                # replay (answered from the pg log, never re-executed)
+                # charges NOTHING, so a client's resends can't inflate
+                # its written-bytes ledger
+                if trk.kind == "read":
+                    trk.rd_bytes = len(outdata)
+                elif trk.kind == "write" and any(
+                        o.get("op") in WRITE_OP_KINDS
+                        and r["rc"] == 0
+                        and not (r.get("out") or {}).get("dup")
+                        for o, r in zip(p.get("ops", []), results)):
+                    trk.wr_bytes = len(msg.data)
             conn.send_message(MOSDOpReply(
                 {"tid": tid, "rc": final_rc, "results": results,
                  "epoch": self.osdmap.epoch}, outdata))

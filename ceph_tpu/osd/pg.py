@@ -36,7 +36,7 @@ from ceph_tpu.objectstore.types import CollectionId, Ghobject
 from ceph_tpu.osd.pglog import ZERO, Eversion, LogEntry, PGLog
 from ceph_tpu.utils import tracer
 from ceph_tpu.utils.dout import dout
-from ceph_tpu.utils.work_queue import mark_op_event
+from ceph_tpu.utils.work_queue import WRITE_OP_KINDS, mark_op_event
 
 if TYPE_CHECKING:
     from ceph_tpu.osd.daemon import OSD
@@ -823,10 +823,12 @@ class PGInstance:
 
     # -- client op execution (primary only) ----------------------------------
 
-    # ops that mutate object state and therefore get a log entry
-    MOD_OPS = frozenset({"write_full", "write", "append", "truncate",
-                         "zero", "create", "delete", "setxattr", "rmxattr",
-                         "omap_set", "omap_rm", "rollback", "snaptrim"})
+    # ops that mutate object state and therefore get a log entry —
+    # derived from the canonical mutating set (work_queue, which the
+    # per-client accountant also classifies by) minus "call": a class
+    # method's ENVELOPE is not logged, the mutations it stages
+    # server-side get their own entries
+    MOD_OPS = WRITE_OP_KINDS - {"call"}
     # the reference rejects omap on EC pools (PrimaryLogPG.cc
     # pool.info.supports_omap()). truncate/zero ride the EC write plan
     # (per-shard truncate sub-ops / zero-fill RMW); snapshots work via

@@ -53,19 +53,33 @@ class RadosClient(Dispatcher):
     BACKOFF_MAX = 2.0
 
     def __init__(self, mon_addrs: list[tuple[str, int]],
-                 auth_key: bytes | None = None):
-        self.messenger = Messenger("client", auth_key=auth_key)
+                 auth_key: bytes | None = None,
+                 name: str | None = None,
+                 tenant: str | None = None):
+        # client instance nonce: makes (nonce, seq) reqids globally
+        # unique so OSDs can dedup retried non-idempotent ops
+        # (osd_reqid_t semantics)
+        import secrets
+        self._nonce = secrets.randbits(48)
+        # client identity (EntityName client.<id>): negotiated once per
+        # msgr2 session at the HELLO handshake and stamped on every
+        # MOSDOp, so the OSD's per-client accountant can attribute ops,
+        # bytes, and tail latency to THIS client. Anonymous callers get
+        # a nonce-derived id — still stable for the client's lifetime.
+        if name:
+            self.name = name if name.startswith("client.") \
+                else f"client.{name}"
+        else:
+            self.name = f"client.{self._nonce:012x}"
+        self.tenant = tenant
+        self.messenger = Messenger(self.name, auth_key=auth_key,
+                                   tenant=tenant)
         self.messenger.add_dispatcher(self)
         self.monc = MonClient(self.messenger, mon_addrs)
         self.monc.on_osdmap = self._on_osdmap
         self.osdmap = OSDMap()
         self._map_changed = asyncio.Event()
         self._tid = 0
-        # client instance nonce: makes (nonce, seq) reqids globally
-        # unique so OSDs can dedup retried non-idempotent ops
-        # (osd_reqid_t semantics)
-        import secrets
-        self._nonce = secrets.randbits(48)
         self._reqseq = 0
         self._waiters: dict[int, asyncio.Future] = {}
         self._osd_conns: dict[int, Connection] = {}
@@ -205,10 +219,16 @@ class RadosClient(Dispatcher):
             tid = self._tid
             fut = asyncio.get_running_loop().create_future()
             self._waiters[tid] = fut
-            conn.send_message(MOSDOp(
-                {"tid": tid, "pgid": [pg.pool, pg.ps], "oid": oid,
-                 "ops": ops, "reqid": reqid,
-                 "epoch": self.osdmap.epoch}, data))
+            # the op is stamped with the session's negotiated identity:
+            # the OSD accountant keys on the handshake entity and uses
+            # this stamp only as the cross-check / requeue-path carrier
+            payload = {"tid": tid, "pgid": [pg.pool, pg.ps], "oid": oid,
+                       "ops": ops, "reqid": reqid,
+                       "epoch": self.osdmap.epoch,
+                       "client": self.name}
+            if self.tenant:
+                payload["tenant"] = self.tenant
+            conn.send_message(MOSDOp(payload, data))
             try:
                 reply = await asyncio.wait_for(
                     fut, min(attempt_timeout or self.ATTEMPT_TIMEOUT,

@@ -951,6 +951,134 @@ def stage_failure_storm() -> dict:
     return results
 
 
+# -- swarm: many-client fairness + per-client SLO observability ---------------
+
+def stage_swarm() -> dict:
+    """The multi-tenant lens, end to end on a live cluster (ROADMAP
+    production-traffic item): >= 200 concurrent librados clients (mixed
+    op sizes, zipfian hot keys, an injected slow-reader band) against
+    an EC pool, with per-client SLO accounting armed on every OSD.
+    Reports aggregate MB/s, the per-client p99 spread, and the
+    fairness ratio max/median client p99 — the number an mClock-style
+    QoS scheduler will be graded on — then verifies the observability
+    pipeline under load: `ceph_client_*` families in a live exporter
+    scrape, and the SLO_VIOLATIONS health check firing (and muting)
+    under the slow-reader overload."""
+    import asyncio
+    import re as _re
+
+    t0 = time.perf_counter()
+    results: dict = {}
+    N_CLIENTS, SECONDS, N_OSDS = 200, 6.0, 4
+    SLO_READ_MS, SLO_WRITE_MS = 250.0, 500.0
+
+    async def _http_get(addr, path: str) -> str:
+        reader, writer = await asyncio.open_connection(*addr)
+        writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+        await writer.drain()
+        blob = await reader.read()
+        writer.close()
+        return blob.split(b"\r\n\r\n", 1)[1].decode()
+
+    async def _poll_health(client, want_check: str, present: bool,
+                           timeout: float = 25.0) -> dict:
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        h: dict = {}
+        while loop.time() < deadline:
+            h = await client.command({"prefix": "health"})
+            if (want_check in h.get("checks", {})) == present:
+                return h
+            await asyncio.sleep(0.5)
+        return h
+
+    async def body():
+        import tempfile
+
+        from ceph_tpu.tools.rados_swarm import raise_fd_limit, run_swarm
+        from ceph_tpu.tools.vstart import VCluster
+
+        raise_fd_limit()
+        with tempfile.TemporaryDirectory(prefix="bench-swarm-") as base:
+            c = VCluster(base, n_mons=1, n_osds=N_OSDS, with_mgr=True)
+            try:
+                await c.start()
+                cl = await c.client()
+                await cl.command({
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "swarmprof",
+                    "profile": {"plugin": "jerasure", "k": "2",
+                                "m": "1"}})
+                await cl.pool_create("swarm", pg_num=8,
+                                     pool_type="erasure",
+                                     erasure_code_profile="swarmprof")
+                # arm the SLO engine hot on every OSD (the observer
+                # pushes straight into the live ClientTable)
+                for osd in c.osds.values():
+                    osd.config.set("slo_read_ms", SLO_READ_MS)
+                    osd.config.set("slo_write_ms", SLO_WRITE_MS)
+                out = await run_swarm(
+                    c.mon_addrs, "swarm", clients=N_CLIENTS,
+                    seconds=SECONDS, objects=128, slow_readers=16,
+                    connect_batch=40)
+                out.pop("per_client", None)
+                results["swarm_clients"] = out["clients"]
+                results["swarm_mb_s"] = out["mb_s"]
+                results["swarm_read_mb_s"] = out["read_mb_s"]
+                results["swarm_write_mb_s"] = out["write_mb_s"]
+                results["swarm_iops"] = out["iops"]
+                results["swarm_errors"] = out["errors"]
+                results["swarm_connect_s"] = out["connect_s"]
+                results["swarm_client_p99_median_ms"] = \
+                    out["median_p99_ms"]
+                results["swarm_client_p99_max_ms"] = out["max_p99_ms"]
+                results["swarm_p99_fairness"] = out["p99_fairness"]
+                log(f"swarm: {out['clients']} clients {out['mb_s']} "
+                    f"MB/s p99 med/max {out['median_p99_ms']}/"
+                    f"{out['max_p99_ms']}ms fairness "
+                    f"{out['p99_fairness']} errors={out['errors']}")
+
+                # per-client accounting really landed on the OSDs
+                tables = [o.optracker.clients.dump_clients(limit=1)
+                          for o in c.osds.values()]
+                results["swarm_osd_clients_tracked"] = sum(
+                    t["num_clients"] for t in tables)
+
+                # SLO_VIOLATIONS must FIRE under the overload...
+                h = await _poll_health(cl, "SLO_VIOLATIONS", True)
+                results["swarm_slo_fired"] = \
+                    "SLO_VIOLATIONS" in h.get("checks", {})
+                # ...the exporter must carry ceph_client_* families...
+                text = await _http_get(c.mgr.exporter.addr, "/metrics")
+                fams = sorted(set(_re.findall(
+                    r"# TYPE (ceph_client_[a-z0-9_]+)", text)))
+                series = sorted(set(_re.findall(
+                    r'ceph_client="([^"]+)"', text)))
+                results["swarm_client_families"] = len(fams)
+                results["swarm_client_series"] = len(series)
+                results["swarm_client_series_capped"] = \
+                    len(series) <= 64
+                log(f"swarm: exporter {len(fams)} ceph_client_* "
+                    f"families, {len(series)} client series "
+                    f"(fired={results['swarm_slo_fired']})")
+                # ...and the check must MUTE on request
+                await cl.command({"prefix": "health mute",
+                                  "code": "SLO_VIOLATIONS", "ttl": 120})
+                h = await _poll_health(cl, "SLO_VIOLATIONS", False,
+                                       timeout=10.0)
+                results["swarm_slo_muted"] = (
+                    "SLO_VIOLATIONS" not in h.get("checks", {})
+                    and "SLO_VIOLATIONS" in h.get("muted", {}))
+                log(f"swarm: SLO_VIOLATIONS muted="
+                    f"{results['swarm_slo_muted']}")
+            finally:
+                await c.stop()
+
+    asyncio.run(asyncio.wait_for(body(), 280))
+    results["elapsed_s"] = round(time.perf_counter() - t0, 1)
+    return results
+
+
 # -- attribution: the "where the 450x goes" waterfall -------------------------
 
 #: waterfall buckets in pipeline order; "other" is the residual the
@@ -1183,7 +1311,7 @@ def stage_attribution() -> dict:
 
 TREND_KEYS = ("tpu_encode", "tpu_decode", "failure_storm_recovery_mb_s",
               "scaling_efficiency", "cluster_ec_write_mb_s",
-              "cluster_ec_tpu_write_mb_s_sharded")
+              "cluster_ec_tpu_write_mb_s_sharded", "swarm_mb_s")
 #: keys where UP is the regression direction: more copied bytes per
 #: written byte, a busier event loop, a slower recovery to clean, a
 #: repair fetch creeping back toward the full-stripe baseline, the
@@ -1193,7 +1321,8 @@ TREND_KEYS = ("tpu_encode", "tpu_decode", "failure_storm_recovery_mb_s",
 TREND_KEYS_COST = ("copy_amplification", "loop_busy_fraction",
                    "failure_storm_time_to_clean_s",
                    "failure_storm_repair_ratio",
-                   "device_busy_skew", "shard_busy_skew")
+                   "device_busy_skew", "shard_busy_skew",
+                   "swarm_p99_fairness")
 TREND_THRESHOLD_PCT = 10.0
 
 
@@ -1277,7 +1406,7 @@ def main() -> int:
     p.add_argument("--stage", choices=["cpu", "probe", "device",
                                        "cluster", "cluster_tpu",
                                        "attribution", "failure_storm",
-                                       "mesh_scaling"],
+                                       "swarm", "mesh_scaling"],
                    required=True)
     args = p.parse_args()
     out = {"cpu": stage_cpu, "probe": stage_probe,
@@ -1285,6 +1414,7 @@ def main() -> int:
            "cluster_tpu": stage_cluster_tpu,
            "attribution": stage_attribution,
            "failure_storm": stage_failure_storm,
+           "swarm": stage_swarm,
            "mesh_scaling": stage_mesh_scaling}[args.stage]()
     print(json.dumps(out), flush=True)
     return 0

@@ -1,0 +1,268 @@
+"""Swarm load generator: hundreds of concurrent librados clients.
+
+The missing half of the production-traffic story (ROADMAP "many-client
+load harness"): every bench so far drives ONE client, but a store is
+judged on how fairly it serves thousands of tenants — and the
+per-client SLO observability (OpTracker ClientTable -> MgrReport ->
+`ceph_client_*` exporter families) is ungradeable until something
+generates attributable multi-tenant load. This is that something: the
+reference analog is a fleet of `rados bench`/cosbench workers, here
+collapsed into one process of N independent `RadosClient` instances,
+each with its own negotiated `client.<id>` identity and tenant label.
+
+Workload shape (the knobs the SSD-array online-EC study, arXiv
+1709.05365, says matter — system-level queueing under CONCURRENT load):
+
+  * mixed op-size distribution: each client draws object sizes from a
+    weighted set (4k metadata-ish writes through 256k bulk);
+  * zipfian hot keys: object picks follow a Zipf(s) rank distribution
+    over a shared namespace, so a handful of hot objects see most of
+    the traffic (same-PG convoys, the contention a fair scheduler must
+    arbitrate);
+  * injected slow readers: a designated fraction of clients hammer
+    full-object reads of the biggest objects with zero pacing (tenant
+    "slowband") — the overload that must show up in OTHER clients'
+    p99, in the SLO violation counters, and eventually in the mon's
+    SLO_VIOLATIONS check.
+
+Fairness figure: `p99_fairness` = max(client p99) / median(client p99).
+1.0 is a perfectly fair cluster; a big ratio means some client eats the
+tail. Trend-guarded by the bench `swarm` stage.
+
+Usage (standalone, boots its own EC cluster):
+    python -m ceph_tpu.tools.rados_swarm [--clients 200] [--seconds 5]
+        [--osds 4] [--k 2] [--m 1] [--slow-readers 8]
+Programmatic: `await run_swarm(mon_addrs, pool, ...)` against a live
+cluster (what the bench stage and tests call).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import time
+
+
+def raise_fd_limit(want: int = 8192) -> None:
+    """Hundreds of clients * (messenger + mon + OSD sessions) blow the
+    default 1024-fd rlimit; raise it as far as the hard cap allows."""
+    try:
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < want:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(want, hard), hard))
+    except (ImportError, ValueError, OSError):
+        pass
+
+
+class _ZipfPicker:
+    """Incremental zipf draws (pre-drawing count for a timed window is
+    impossible); cumulative-weight bisect per draw."""
+
+    def __init__(self, n: int, s: float):
+        import bisect
+        self._bisect = bisect
+        self.cum = []
+        total = 0.0
+        for r in range(n):
+            total += 1.0 / (r + 1) ** s
+            self.cum.append(total)
+        self.total = total
+
+    def pick(self, rng: random.Random) -> int:
+        return self._bisect.bisect_left(self.cum,
+                                        rng.random() * self.total)
+
+
+#: (size_bytes, weight) mixed op-size distribution defaults: mostly
+#: small ops with a bulk tail — the shape that exposes per-op overhead
+#: AND byte-bandwidth contention at once
+DEFAULT_SIZES = ((4096, 8), (16384, 4), (65536, 2), (262144, 1))
+
+
+async def run_swarm(mon_addrs, pool: str, *,
+                    clients: int = 200,
+                    seconds: float = 5.0,
+                    objects: int = 128,
+                    sizes=DEFAULT_SIZES,
+                    zipf_s: float = 1.1,
+                    read_fraction: float = 0.5,
+                    slow_readers: int = 0,
+                    tenants: int = 4,
+                    seed: int = 1234,
+                    connect_batch: int = 32,
+                    auth_key: bytes | None = None,
+                    client_prefix: str = "sw") -> dict:
+    """Drive `clients` concurrent librados clients against `pool` for
+    `seconds`; returns aggregate MB/s, per-client p99, and the fairness
+    ratio. The cluster must already exist; the namespace is seeded
+    before the timed window so reads never miss."""
+    from ceph_tpu.rados.client import RadosClient
+
+    raise_fd_limit()
+    rng = random.Random(seed)
+    size_vals = [s for s, _w in sizes]
+    size_weights = [w for _s, w in sizes]
+    picker = _ZipfPicker(objects, zipf_s)
+    # object r's size is fixed by its rank so reads know what they get
+    obj_size = {r: size_vals[r % len(size_vals)] for r in range(objects)}
+    big = max(size_vals)
+    big_objs = [r for r in range(objects) if obj_size[r] == big] or [0]
+
+    # -- connect the fleet (batched: each connect waits for an osdmap) --
+    fleet: list[RadosClient] = []
+    n_slow = min(slow_readers, clients)
+
+    async def _connect(i: int) -> RadosClient:
+        slow = i >= clients - n_slow
+        c = RadosClient(
+            mon_addrs, auth_key=auth_key,
+            name=f"{client_prefix}{i:04d}",
+            tenant="slowband" if slow
+            else f"tenant{i % max(1, tenants)}")
+        await c.connect()
+        return c
+
+    t_connect = time.monotonic()
+    for base in range(0, clients, connect_batch):
+        batch = await asyncio.gather(
+            *[_connect(i) for i in range(base,
+                                         min(clients, base + connect_batch))])
+        fleet.extend(batch)
+    connect_s = time.monotonic() - t_connect
+
+    # -- seed the namespace (outside the timed window) ------------------
+    seeder = fleet[0].ioctx(pool)
+    await asyncio.gather(*[
+        seeder.write_full(f"sw-{r:04d}", bytes(obj_size[r]))
+        for r in range(objects)])
+
+    # -- timed window ---------------------------------------------------
+    per_client: dict[str, dict] = {}
+    stop_at = time.monotonic() + seconds
+    t0 = time.monotonic()
+
+    async def worker(idx: int, c: RadosClient) -> None:
+        io = c.ioctx(pool)
+        crng = random.Random((seed << 16) ^ idx)
+        slow = idx >= clients - n_slow
+        lats: list[float] = []
+        stats = {"ops": 0, "read_bytes": 0, "written_bytes": 0,
+                 "errors": 0, "tenant": c.tenant, "slow_reader": slow}
+        per_client[c.name] = stats
+        while time.monotonic() < stop_at:
+            t_op = time.monotonic()
+            try:
+                if slow:
+                    # slowband: unpaced full reads of the biggest
+                    # objects — the overload injection
+                    r = crng.choice(big_objs)
+                    data = await io.read(f"sw-{r:04d}")
+                    stats["read_bytes"] += len(data)
+                elif crng.random() < read_fraction:
+                    r = picker.pick(crng)
+                    data = await io.read(f"sw-{r:04d}")
+                    stats["read_bytes"] += len(data)
+                else:
+                    r = picker.pick(crng)
+                    # draw the size fresh from the distribution: sizes
+                    # fluctuate around the mix instead of ratcheting
+                    # down, so the big objects the slowband readers
+                    # hammer keep existing for the whole window
+                    size = crng.choices(size_vals, size_weights)[0]
+                    if r in big_objs:
+                        size = big
+                    await io.write_full(f"sw-{r:04d}",
+                                        bytes(size))
+                    obj_size[r] = size
+                    stats["written_bytes"] += size
+                stats["ops"] += 1
+                lats.append((time.monotonic() - t_op) * 1e3)
+            except Exception:
+                stats["errors"] += 1
+        lats.sort()
+        n = len(lats)
+        stats["p50_ms"] = round(lats[n // 2], 2) if n else 0.0
+        stats["p99_ms"] = round(lats[min(n - 1, int(n * 0.99))], 2) \
+            if n else 0.0
+
+    await asyncio.gather(*[worker(i, c) for i, c in enumerate(fleet)])
+    elapsed = time.monotonic() - t0
+
+    # -- teardown -------------------------------------------------------
+    for base in range(0, len(fleet), connect_batch):
+        await asyncio.gather(
+            *[c.shutdown() for c in fleet[base:base + connect_batch]])
+
+    # -- aggregate ------------------------------------------------------
+    total_ops = sum(s["ops"] for s in per_client.values())
+    rd = sum(s["read_bytes"] for s in per_client.values())
+    wr = sum(s["written_bytes"] for s in per_client.values())
+    errors = sum(s["errors"] for s in per_client.values())
+    p99s = sorted(s["p99_ms"] for s in per_client.values() if s["ops"])
+    fair = {"median_p99_ms": 0.0, "max_p99_ms": 0.0,
+            "p99_fairness": 0.0}
+    if p99s:
+        med = p99s[len(p99s) // 2]
+        fair = {"median_p99_ms": med, "max_p99_ms": p99s[-1],
+                "p99_fairness": round(p99s[-1] / med, 3) if med else 0.0}
+    return {
+        "clients": clients, "slow_readers": n_slow,
+        "seconds": round(elapsed, 3),
+        "connect_s": round(connect_s, 2),
+        "objects": objects, "zipf_s": zipf_s,
+        "ops": total_ops,
+        "iops": round(total_ops / elapsed, 1) if elapsed else 0.0,
+        "mb_s": round((rd + wr) / elapsed / 1e6, 2) if elapsed else 0.0,
+        "read_mb_s": round(rd / elapsed / 1e6, 2) if elapsed else 0.0,
+        "write_mb_s": round(wr / elapsed / 1e6, 2) if elapsed else 0.0,
+        "errors": errors,
+        **fair,
+        "per_client": per_client,
+    }
+
+
+async def _main(args) -> dict:
+    from ceph_tpu.tools.cluster_boot import ephemeral_cluster
+
+    raise_fd_limit()
+    async with ephemeral_cluster(args.osds, prefix="rados-swarm-") \
+            as (client, _osds, mon):
+        await client.command({
+            "prefix": "osd erasure-code-profile set",
+            "name": "swarmprof",
+            "profile": {"plugin": "jerasure", "k": str(args.k),
+                        "m": str(args.m)}})
+        await client.pool_create("swarm", pg_num=8,
+                                 pool_type="erasure",
+                                 erasure_code_profile="swarmprof")
+        out = await run_swarm(
+            list(mon.monmap.mons.values()), "swarm",
+            clients=args.clients, seconds=args.seconds,
+            objects=args.objects, slow_readers=args.slow_readers,
+            zipf_s=args.zipf)
+        if not args.per_client:
+            out.pop("per_client", None)
+        return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=200)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--objects", type=int, default=128)
+    ap.add_argument("--osds", type=int, default=4)
+    ap.add_argument("--k", type=int, default=2)
+    ap.add_argument("--m", type=int, default=1)
+    ap.add_argument("--slow-readers", type=int, default=8)
+    ap.add_argument("--zipf", type=float, default=1.1)
+    ap.add_argument("--per-client", action="store_true",
+                    help="include the full per-client table in the JSON")
+    args = ap.parse_args()
+    print(json.dumps(asyncio.run(_main(args))))
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,16 @@ TYPE_AVG = "avg"
 TYPE_HISTOGRAM = "histogram"
 
 
+def pow2_bucket(value: float) -> int:
+    """Power-of-two bucket index: bucket i counts values in
+    [2^i, 2^(i+1)); 4096 lands in "2^12". The ONE bucketing rule —
+    every histogram source (these counters, the per-client latency
+    tables) must share it or cross-source merges and the exporter's
+    cumulative `le` edges silently disagree."""
+    return max(0, min(63, int(value).bit_length() - 1)) if value >= 1 \
+        else 0
+
+
 class PerfCounters:
     """One component's named counters (PerfCountersBuilder output)."""
 
@@ -99,9 +109,7 @@ class PerfCounters:
 
     def hist_add(self, key: str, value: float) -> None:
         self._check(key, TYPE_HISTOGRAM)
-        # bucket i counts values in [2^i, 2^(i+1)); 4096 lands in "2^12"
-        bucket = max(0, min(63, int(value).bit_length() - 1)) if value >= 1 \
-            else 0
+        bucket = pow2_bucket(value)
         with self._lock:
             self._buckets[key][bucket] += 1
             self._values[key] += value

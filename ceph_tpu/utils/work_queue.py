@@ -13,23 +13,63 @@ Re-creations of:
     per-op event timelines, in-flight dump, bounded historic ring and
     slow-op accounting, exposed via the admin socket
     (`dump_ops_in_flight`, `dump_historic_ops` — the reference's
-    debugging workhorse).
+    debugging workhorse);
+  * per-client accountant (ClientTable): the OpTracker grown into the
+    multi-tenant lens — a bounded top-K table attributing ops, bytes,
+    in-flight depth, and read/write latency histograms to individual
+    `client.<id>` entities (identity negotiated at the msgr2 handshake,
+    stamped on MOSDOp), with a configurable SLO engine
+    (`slo_read_ms`/`slo_write_ms`) counting good-vs-violating ops per
+    client. This is the accounting substrate an mClock-style QoS
+    scheduler arbitrates on (src/osd/scheduler/mClockScheduler.h needs
+    exactly these per-client tallies), surfaced via the admin-socket
+    `dump_clients` verb and the MgrReport `client_metrics` path.
 
 Idiomatic divergences: shards are asyncio tasks on one loop rather than
 threads (the loop is the concurrency substrate everywhere in this
 stack); timeline stamps come from time.monotonic with wall-clock start.
+All age/duration math derives from the monotonic `_t0` ONLY — the
+wall-clock `initiated_at` is display metadata (an NTP step must never
+turn into a phantom slow op or a negative latency).
 """
 from __future__ import annotations
 
 import asyncio
 import collections
 import contextvars
+import threading
 import time
 from typing import Awaitable, Callable
 
 from ceph_tpu.utils.async_util import being_cancelled
 from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.perf_counters import (TYPE_GAUGE, PerfCounters,
+                                          pow2_bucket)
 from ceph_tpu.utils.throttle import HeartbeatMap
+
+#: op kinds that mutate state — a client op carrying any of these is
+#: accounted as a WRITE (bytes = the data segment it shipped); pure
+#: reads are accounted by the bytes they returned. Watch/notify and
+#: listing ops are "other": they gather for seconds by design, and
+#: folding them into the read histogram would poison every read SLO.
+#: This is the ONE mutating-op set: PG.MOD_OPS (the ops that get a log
+#: entry) derives from it, so the two can never drift apart.
+WRITE_OP_KINDS = frozenset({
+    "write_full", "write", "append", "truncate", "zero", "create",
+    "delete", "setxattr", "rmxattr", "omap_set", "omap_rm", "rollback",
+    "snaptrim", "call"})
+OTHER_OP_KINDS = frozenset({"watch", "unwatch", "notify", "list",
+                            "list_watchers", "list_snaps"})
+
+
+def classify_ops(ops: list[dict]) -> str:
+    """'write' | 'read' | 'other' for a client op vector."""
+    kinds = {o.get("op") for o in ops}
+    if kinds & WRITE_OP_KINDS:
+        return "write"
+    if kinds and kinds <= OTHER_OP_KINDS:
+        return "other"
+    return "read"
 
 # the op being processed by the current task — backends stamp events on
 # it without threading a handle through every call (the reference passes
@@ -53,16 +93,362 @@ def mark_op_event(event: str) -> None:
         op.mark_event(event)
 
 
+def current_op() -> "TrackedOp | None":
+    """The TrackedOp the current task is executing (None outside one).
+    Op-execution paths use this to stamp per-client byte/kind
+    accounting without threading the handle through every call."""
+    return _current_op.get()
+
+
+def _win_quantile(window, q: float) -> float:
+    """Quantile (µs) over a rolling latency window; 0 when empty."""
+    if not window:
+        return 0.0
+    vals = sorted(window)
+    return vals[min(len(vals) - 1, int(q * (len(vals) - 1)))]
+
+
+class _ClientEntry:
+    """One client's running tallies (all timing monotonic-derived)."""
+
+    __slots__ = ("name", "tenant", "ops", "rd_ops", "wr_ops",
+                 "rd_bytes", "wr_bytes", "in_flight",
+                 "rd_buckets", "wr_buckets", "rd_win", "wr_win",
+                 "slo_good", "slo_violations", "viol_stamps",
+                 "last_active", "folded_from")
+
+    def __init__(self, name: str, tenant: str | None,
+                 window: int) -> None:
+        self.name = name
+        self.tenant = tenant
+        self.ops = 0
+        self.rd_ops = 0
+        self.wr_ops = 0
+        self.rd_bytes = 0
+        self.wr_bytes = 0
+        self.in_flight = 0
+        self.rd_buckets: dict[int, int] = {}
+        self.wr_buckets: dict[int, int] = {}
+        self.rd_win: collections.deque[float] = \
+            collections.deque(maxlen=window)
+        self.wr_win: collections.deque[float] = \
+            collections.deque(maxlen=window)
+        self.slo_good = 0
+        self.slo_violations = 0
+        # monotonic stamps of recent violations: the health surface
+        # reports violations within a sliding window, so SLO_VIOLATIONS
+        # clears by itself once an overload ends
+        self.viol_stamps: collections.deque[float] = \
+            collections.deque(maxlen=512)
+        self.last_active = time.monotonic()
+        self.folded_from = 0        # entries merged into this one
+
+    def absorb(self, other: "_ClientEntry") -> None:
+        """Fold `other`'s tallies into this (the `_other` overflow row).
+        in_flight is deliberately NOT absorbed: the victim's still-open
+        ops re-materialize its row at finish time with a clamped
+        decrement, so moving the count here would strand it in `_other`
+        forever (a gauge that only ever rises). In-flight depth is a
+        property of LIVE identities; a folded client forfeits its
+        snapshot and restarts at zero."""
+        self.ops += other.ops
+        self.rd_ops += other.rd_ops
+        self.wr_ops += other.wr_ops
+        self.rd_bytes += other.rd_bytes
+        self.wr_bytes += other.wr_bytes
+        for b, n in other.rd_buckets.items():
+            self.rd_buckets[b] = self.rd_buckets.get(b, 0) + n
+        for b, n in other.wr_buckets.items():
+            self.wr_buckets[b] = self.wr_buckets.get(b, 0) + n
+        self.slo_good += other.slo_good
+        self.slo_violations += other.slo_violations
+        self.viol_stamps.extend(other.viol_stamps)
+        self.folded_from += 1 + other.folded_from
+
+
+class ClientTable(PerfCounters):
+    """Bounded top-K per-client accountant + SLO engine.
+
+    A PerfCounters subclass so the process-wide collection owns its
+    aggregate counters AND `perf reset` (admin socket) zeroes the
+    per-client tables with everything else. The per-client detail
+    travels the MgrReport `client_metrics` path (mgr merges across
+    OSDs; exporter renders `ceph_client_*` families), never the
+    counter delta path — 64-bucket histograms per client would bloat
+    every report.
+
+    Thread contract: mutation happens on the OSD loop; `dump_clients`
+    and `perf dump`/`perf reset` arrive from admin-socket threads. A
+    dedicated table lock (separate from the PerfCounters counter lock,
+    which `self.inc` takes internally) covers the entry dict; lock
+    order is always table -> counter, never the reverse.
+    """
+
+    WINDOW = 512                   # rolling-latency samples per client
+    SLO_RECENT_S = 30.0            # violation freshness window (health)
+    SLOW_CLIENT_FACTOR = 4.0       # p99 > factor*SLO => SLOW_CLIENT
+    OTHER = "_other"               # the overflow fold row
+
+    def __init__(self, name: str = "optracker.clients",
+                 max_entries: int = 256):
+        super().__init__(name)
+        self.add("clients", type=TYPE_GAUGE,
+                 description="distinct client entities tracked")
+        self.add("client_ops",
+                 description="client ops accounted to an entity")
+        self.add("client_read_bytes",
+                 description="bytes returned to clients by reads")
+        self.add("client_written_bytes",
+                 description="bytes accepted from clients by writes "
+                             "(dup-op replays excluded)")
+        self.add("client_slo_good",
+                 description="ops that met their class SLO")
+        self.add("client_slo_violations",
+                 description="ops that blew their class SLO")
+        self.add("clients_folded",
+                 description="client entries folded into _other by "
+                             "the top-K table bound")
+        self._tlock = threading.Lock()
+        self._entries: dict[str, _ClientEntry] = {}
+        self.max_entries = max(2, int(max_entries))
+        # SLO thresholds in SECONDS (0 = class unguarded); set from the
+        # slo_read_ms / slo_write_ms config observer, hot
+        self.slo_read_s = 0.0
+        self.slo_write_s = 0.0
+
+    # -- config hooks --------------------------------------------------------
+
+    def set_slo(self, read_ms: float | None = None,
+                write_ms: float | None = None) -> None:
+        if read_ms is not None:
+            self.slo_read_s = max(0.0, float(read_ms)) / 1e3
+        if write_ms is not None:
+            self.slo_write_s = max(0.0, float(write_ms)) / 1e3
+
+    def resize(self, max_entries: int) -> None:
+        self.max_entries = max(2, int(max_entries))
+        with self._tlock:
+            while len(self._entries) > self.max_entries:
+                if not self._fold_one_locked():
+                    break
+
+    # -- accounting (OSD loop) -----------------------------------------------
+
+    def _entry_locked(self, client: str,
+                      tenant: str | None) -> _ClientEntry:
+        e = self._entries.get(client)
+        if e is None:
+            # fold until the INSERT below lands within the bound — the
+            # first fold may be size-neutral (it creates `_other`), so
+            # loop; _fold_one_locked returning False (only `_other`
+            # left) breaks the loop
+            while len(self._entries) >= self.max_entries:
+                if not self._fold_one_locked():
+                    break
+            e = self._entries[client] = _ClientEntry(client, tenant,
+                                                     self.WINDOW)
+        elif tenant and e.tenant is None:
+            e.tenant = tenant
+        return e
+
+    def _fold_one_locked(self) -> bool:
+        """Evict the least-recently-active entry into `_other` (bounded
+        top-K: identities churn, tallies are never dropped)."""
+        victim = min(
+            (e for k, e in self._entries.items() if k != self.OTHER),
+            key=lambda e: e.last_active, default=None)
+        if victim is None:
+            return False
+        del self._entries[victim.name]
+        other = self._entries.get(self.OTHER)
+        if other is None:
+            other = self._entries[self.OTHER] = _ClientEntry(
+                self.OTHER, None, self.WINDOW)
+        other.absorb(victim)
+        other.last_active = time.monotonic()
+        self.inc("clients_folded")
+        return True
+
+    def op_start(self, client: str, tenant: str | None = None) -> None:
+        with self._tlock:
+            e = self._entry_locked(client, tenant)
+            e.in_flight += 1
+            e.last_active = time.monotonic()
+            n = len(self._entries)
+        self.set("clients", n)
+
+    def op_finished(self, op: "TrackedOp") -> None:
+        """Account a finished tracked op: latency into the client's
+        kind histogram + rolling window, bytes, SLO verdict. Duration
+        is the op's monotonic duration — wall time never enters."""
+        dur_s = op.duration
+        us = dur_s * 1e6
+        now = time.monotonic()
+        viol = good = 0
+        with self._tlock:
+            # a folded (or reset-raced) client re-materializes: its
+            # in-flight decrement must land on the row that carries it
+            e = self._entries.get(op.client) \
+                or self._entry_locked(op.client, op.tenant)
+            e.in_flight = max(0, e.in_flight - 1)
+            e.last_active = now
+            e.ops += 1
+            if op.kind == "read":
+                e.rd_ops += 1
+                e.rd_bytes += op.rd_bytes
+                b = pow2_bucket(us)
+                e.rd_buckets[b] = e.rd_buckets.get(b, 0) + 1
+                e.rd_win.append(us)
+                slo = self.slo_read_s
+                if slo > 0:
+                    if dur_s > slo:
+                        viol, e.slo_violations = 1, e.slo_violations + 1
+                        e.viol_stamps.append(now)
+                    else:
+                        good, e.slo_good = 1, e.slo_good + 1
+            elif op.kind == "write":
+                e.wr_ops += 1
+                e.wr_bytes += op.wr_bytes
+                b = pow2_bucket(us)
+                e.wr_buckets[b] = e.wr_buckets.get(b, 0) + 1
+                e.wr_win.append(us)
+                slo = self.slo_write_s
+                if slo > 0:
+                    if dur_s > slo:
+                        viol, e.slo_violations = 1, e.slo_violations + 1
+                        e.viol_stamps.append(now)
+                    else:
+                        good, e.slo_good = 1, e.slo_good + 1
+        self.inc("client_ops")
+        if op.rd_bytes:
+            self.inc("client_read_bytes", op.rd_bytes)
+        if op.wr_bytes:
+            self.inc("client_written_bytes", op.wr_bytes)
+        if viol:
+            self.inc("client_slo_violations")
+        elif good:
+            self.inc("client_slo_good")
+
+    # -- surfaces ------------------------------------------------------------
+
+    def dump_clients(self, limit: int | None = None) -> dict:
+        """Admin-socket `dump_clients`: the top-K table, ops-sorted,
+        with rolling-window p50/p99 per class and the SLO ledger."""
+        now = time.monotonic()
+        with self._tlock:
+            entries = sorted(self._entries.values(),
+                             key=lambda e: e.ops, reverse=True)
+            if limit:
+                entries = entries[:int(limit)]
+            rows = []
+            for e in entries:
+                rows.append({
+                    "client": e.name, "tenant": e.tenant,
+                    "ops": e.ops, "read_ops": e.rd_ops,
+                    "write_ops": e.wr_ops,
+                    "read_bytes": e.rd_bytes,
+                    "written_bytes": e.wr_bytes,
+                    "in_flight": e.in_flight,
+                    "read_ms": {
+                        "p50": round(_win_quantile(e.rd_win, 0.5) / 1e3,
+                                     3),
+                        "p99": round(_win_quantile(e.rd_win, 0.99) / 1e3,
+                                     3)},
+                    "write_ms": {
+                        "p50": round(_win_quantile(e.wr_win, 0.5) / 1e3,
+                                     3),
+                        "p99": round(_win_quantile(e.wr_win, 0.99) / 1e3,
+                                     3)},
+                    "slo": {"good": e.slo_good,
+                            "violations": e.slo_violations},
+                    "idle_s": round(now - e.last_active, 3),
+                    "folded_from": e.folded_from})
+            return {"num_clients": len(self._entries),
+                    "table_bound": self.max_entries,
+                    "slo_read_ms": round(self.slo_read_s * 1e3, 3),
+                    "slo_write_ms": round(self.slo_write_s * 1e3, 3),
+                    "clients": rows}
+
+    def mgr_metrics(self) -> dict:
+        """Per-client tallies for the MgrReport `client_metrics` path.
+        Ships raw histogram buckets (power-of-two µs exponents) so the
+        mgr can merge a client's latency distribution ACROSS OSDs and
+        quote honest cross-cluster percentiles."""
+        with self._tlock:
+            out = {}
+            for e in self._entries.values():
+                out[e.name] = {
+                    "tenant": e.tenant, "ops": e.ops,
+                    "read_ops": e.rd_ops, "write_ops": e.wr_ops,
+                    "read_bytes": e.rd_bytes,
+                    "written_bytes": e.wr_bytes,
+                    "in_flight": e.in_flight,
+                    "slo_good": e.slo_good,
+                    "slo_violations": e.slo_violations,
+                    "read_buckets": {str(b): n for b, n
+                                     in sorted(e.rd_buckets.items())},
+                    "write_buckets": {str(b): n for b, n
+                                      in sorted(e.wr_buckets.items())}}
+            return out
+
+    def health_metrics(self) -> dict:
+        """The SLO health surface for the mgr digest: violations inside
+        the freshness window (self-clearing once an overload ends) and
+        clients whose rolling p99 sits far beyond the SLO."""
+        now = time.monotonic()
+        horizon = now - self.SLO_RECENT_S
+        recent = 0
+        violating = []
+        slow = []
+        with self._tlock:
+            for e in self._entries.values():
+                r = sum(1 for t in e.viol_stamps if t >= horizon)
+                if r:
+                    recent += r
+                    violating.append({"client": e.name, "recent": r})
+                for kind, win, slo in (("read", e.rd_win,
+                                        self.slo_read_s),
+                                       ("write", e.wr_win,
+                                        self.slo_write_s)):
+                    if slo <= 0 or len(win) < 8:
+                        continue
+                    p99_us = _win_quantile(win, 0.99)
+                    if p99_us > self.SLOW_CLIENT_FACTOR * slo * 1e6:
+                        slow.append({
+                            "client": e.name, "kind": kind,
+                            "p99_ms": round(p99_us / 1e3, 1),
+                            "slo_ms": round(slo * 1e3, 1)})
+            tracked = len(self._entries)
+        violating.sort(key=lambda v: v["recent"], reverse=True)
+        return {"tracked": tracked,
+                "recent_violations": recent,
+                "violating_clients": violating[:16],
+                "slow_clients": slow[:16]}
+
+    def reset(self) -> None:
+        """`perf reset` contract: the aggregate counters AND the whole
+        per-client table (histogram buckets, rolling windows, SLO
+        ledgers) go to zero — a reset scrape shows empty buckets."""
+        super().reset()
+        with self._tlock:
+            self._entries.clear()
+
+
 class TrackedOp:
     """One op's lifetime: description + stamped event timeline."""
 
     __slots__ = ("tracker", "seq", "description", "initiated_at",
-                 "_t0", "events", "done", "trace")
+                 "_t0", "events", "done", "trace",
+                 "client", "tenant", "kind", "rd_bytes", "wr_bytes")
 
-    def __init__(self, tracker: "OpTracker", seq: int, description: str):
+    def __init__(self, tracker: "OpTracker", seq: int, description: str,
+                 client: str | None = None, tenant: str | None = None):
         self.tracker = tracker
         self.seq = seq
         self.description = description
+        # wall-clock stamp for DISPLAY ONLY (historic-op dumps show a
+        # human-readable start time); every age/duration derives from
+        # the monotonic _t0 so a wall-clock step cannot fake a slow op
         self.initiated_at = time.time()
         self._t0 = time.monotonic()
         self.events: list[tuple[float, str]] = [(0.0, "initiated")]
@@ -72,6 +458,14 @@ class TrackedOp:
         # task, so the contextvar alone cannot), and lets historic-op
         # dumps name the trace an op belongs to
         self.trace: dict | None = None
+        # per-client accounting: identity from the session handshake,
+        # kind/bytes filled in by the op execution path (rd/wr bytes
+        # stay zero on dup-op replays so a retry never double-counts)
+        self.client = client
+        self.tenant = tenant
+        self.kind: str | None = None
+        self.rd_bytes = 0
+        self.wr_bytes = 0
 
     def mark_event(self, event: str) -> None:
         self.events.append((round(time.monotonic() - self._t0, 6), event))
@@ -88,10 +482,16 @@ class TrackedOp:
             self.tracker._finished(self)
 
     def to_dict(self) -> dict:
+        # "age" is monotonic-derived; "initiated_at" is the wall stamp
+        # for humans correlating dumps with logs, nothing computes on it
         out = {"seq": self.seq, "description": self.description,
                "initiated_at": self.initiated_at,
                "age": round(self.duration, 6),
                "events": [{"t": t, "event": e} for t, e in self.events]}
+        if self.client:
+            out["client"] = self.client
+            if self.tenant:
+                out["tenant"] = self.tenant
         if self.trace is not None:
             out["trace_id"] = format(self.trace["t"], "016x")
         return out
@@ -101,7 +501,8 @@ class OpTracker:
     """In-flight registry + bounded historic ring (TrackedOp.h)."""
 
     def __init__(self, history_size: int = 20, history_slow_size: int = 20,
-                 slow_threshold: float = 1.0):
+                 slow_threshold: float = 1.0,
+                 clients: ClientTable | None = None):
         self._seq = 0
         self.ops_in_flight: dict[int, TrackedOp] = {}
         self.historic: collections.deque[TrackedOp] = \
@@ -110,16 +511,25 @@ class OpTracker:
             collections.deque(maxlen=history_slow_size)
         self.slow_threshold = slow_threshold
         self.slow_count = 0
+        # the per-client accountant rides the tracker: every tracked op
+        # carrying a client identity lands in its table on finish
+        self.clients = clients if clients is not None else ClientTable()
 
-    def create(self, description: str) -> TrackedOp:
+    def create(self, description: str, client: str | None = None,
+               tenant: str | None = None) -> TrackedOp:
         self._seq += 1
-        op = TrackedOp(self, self._seq, description)
+        op = TrackedOp(self, self._seq, description,
+                       client=client, tenant=tenant)
         self.ops_in_flight[op.seq] = op
+        if client:
+            self.clients.op_start(client, tenant)
         return op
 
     def _finished(self, op: TrackedOp) -> None:
         self.ops_in_flight.pop(op.seq, None)
         self.historic.append(op)
+        if op.client:
+            self.clients.op_finished(op)
         if op.duration >= self.slow_threshold:
             self.slow_count += 1
             self.historic_slow.append(op)

@@ -1,0 +1,15 @@
+"""Known-positive decl-use: the per-client surface rotted — an SLO
+knob no observer family covers (tuning it changes nothing), and a
+per-client aggregate counter that would graph forever-zero."""
+
+
+class PerfCounters:        # base stub: the lint keys on the base NAME
+    pass
+
+
+class GhostClientCounters(PerfCounters):
+    def __init__(self, config, Option):
+        config.declare(Option("slo_burst_ms_dead", "float", 0.0,
+                              "an SLO knob nobody consults"))
+        self.add("client_ghost_violations",
+                 description="per-client counter never incremented")

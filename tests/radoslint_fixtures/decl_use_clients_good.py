@@ -1,0 +1,50 @@
+"""Known-negative decl-use: the per-client SLO surface declared the way
+osd/daemon.py + utils/work_queue.py really declare it — the SLO config
+knobs hot-applied through an observer family, and the ClientTable's
+aggregate counters declared in a PerfCounters subclass and incremented
+on the accounting path (the subclass self.add/self.inc recognition)."""
+
+
+def OPTIONS(Option):
+    return [Option("slo_read_ms", "float", 0.0,
+                   "applied via the observer below"),
+            Option("slo_write_ms", "float", 0.0,
+                   "applied via the observer below"),
+            Option("osd_max_client_entries", "int", 256,
+                   "applied via the observer below")]
+
+
+def register_config(config, Option, table):
+    names = []
+    for opt in OPTIONS(Option):
+        names.append(opt.name)
+        config.declare(opt)
+
+    def _on_change(name, value):
+        if name == "slo_read_ms":
+            table.set_slo(read_ms=float(value))
+        elif name == "slo_write_ms":
+            table.set_slo(write_ms=float(value))
+        elif name == "osd_max_client_entries":
+            table.resize(int(value))
+
+    config.add_observer(tuple(names), _on_change)
+
+
+class PerfCounters:        # base stub: the lint keys on the base NAME
+    pass
+
+
+class ClientCounters(PerfCounters):
+    """PerfCounters subclass: self.add declares, self.inc uses."""
+
+    def __init__(self):
+        self.add("client_ops",
+                 description="incremented in account() below")
+        self.add("client_slo_violations",
+                 description="incremented in account() below")
+
+    def account(self, violated):
+        self.inc("client_ops")
+        if violated:
+            self.inc("client_slo_violations")

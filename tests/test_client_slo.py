@@ -1,0 +1,511 @@
+"""Per-client SLO observability: client identity through the msgr2
+handshake and MOSDOp stamps, the OpTracker ClientTable accountant
+(bounded top-K, SLO engine, dup-replay byte correctness), the mgr-side
+cross-OSD merge + `ceph_client_*` exporter families with the
+cardinality cap, the SLO_VIOLATIONS / SLOW_CLIENT digest checks, the
+`perf reset` contract over client tables, and the swarm load harness.
+
+Reference surfaces: src/common/TrackedOp.h (per-op tracking this grows
+per-client), src/osd/scheduler/mClockScheduler.h (the QoS arbiter this
+accounting substrate feeds), src/pybind/mgr/prometheus (labeled
+export), src/mon/health_check.h (check map).
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.mgr import DaemonStateIndex, MgrDaemon
+from ceph_tpu.mgr.exporter import render_metrics
+from ceph_tpu.msg.frames import Frame, Tag
+from ceph_tpu.msg.messages import Message, MOSDOp, MPing
+from ceph_tpu.msg.messenger import Messenger, Policy
+from ceph_tpu.rados import RadosClient
+from ceph_tpu.utils.admin_socket import AdminSocket
+from ceph_tpu.utils.perf_counters import (TYPE_HISTOGRAM,
+                                          PerfCountersCollection)
+from ceph_tpu.utils.work_queue import ClientTable, OpTracker, classify_ops
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+
+
+# -- ClientTable unit behavior ------------------------------------------------
+
+def test_client_table_accounting_slo_and_bound():
+    t = ClientTable("t.clients", max_entries=4)
+    t.set_slo(read_ms=50.0, write_ms=100.0)
+    trk = OpTracker(clients=t)
+
+    def one_op(client, kind, dur_s, rd=0, wr=0, tenant=None):
+        op = trk.create("op", client=client, tenant=tenant)
+        op.kind = kind
+        op.rd_bytes, op.wr_bytes = rd, wr
+        op._t0 -= dur_s            # backdate: monotonic-derived duration
+        op.finish()
+
+    one_op("client.a", "read", 0.01, rd=4096, tenant="gold")
+    one_op("client.a", "write", 0.5, wr=8192)      # violates 100ms
+    one_op("client.b", "read", 0.2, rd=100)        # violates 50ms
+    d = t.dump_clients()
+    assert d["num_clients"] == 2
+    a = next(r for r in d["clients"] if r["client"] == "client.a")
+    assert a["ops"] == 2 and a["read_bytes"] == 4096 \
+        and a["written_bytes"] == 8192
+    assert a["tenant"] == "gold"
+    assert a["slo"] == {"good": 1, "violations": 1}
+    assert a["write_ms"]["p99"] >= 500.0
+    b = next(r for r in d["clients"] if r["client"] == "client.b")
+    assert b["slo"] == {"good": 0, "violations": 1}
+    # aggregate counters moved with the table
+    dump = t.dump()
+    assert dump["client_ops"] == 3
+    assert dump["client_slo_violations"] == 2
+    assert dump["client_slo_good"] == 1
+    assert dump["client_written_bytes"] == 8192
+    # health surface: violations are recent, so they report
+    hm = t.health_metrics()
+    assert hm["recent_violations"] == 2
+    assert {v["client"] for v in hm["violating_clients"]} == \
+        {"client.a", "client.b"}
+
+    # top-K bound: a 5th client folds the least-recently-active row
+    # into _other — tallies survive, identity does not, and the bound
+    # holds INCLUSIVE of the _other row
+    for i in range(4):
+        one_op(f"client.x{i}", "read", 0.001, rd=10)
+    d = t.dump_clients()
+    assert d["num_clients"] <= 4
+    names = {r["client"] for r in d["clients"]}
+    assert ClientTable.OTHER in names
+    total_ops = sum(r["ops"] for r in d["clients"])
+    assert total_ops == 7                      # nothing dropped
+    assert t.dump()["clients_folded"] >= 1
+
+    # reset zeroes the TABLE, not just the counters (perf reset path)
+    t.reset()
+    assert t.dump_clients()["num_clients"] == 0
+    assert t.dump()["client_ops"] == 0
+
+
+def test_fold_does_not_strand_in_flight():
+    """A client folded into _other while it still has ops in flight
+    must not leave a permanent in_flight residue anywhere: the victim
+    forfeits its snapshot (absorb skips in_flight) and its finish lands
+    on a re-materialized row with a clamped decrement."""
+    t = ClientTable("t.inflight", max_entries=2)
+    trk = OpTracker(clients=t)
+    op_a = trk.create("a", client="client.a")      # left in flight
+    trk.create("b", client="client.b").finish()
+    trk.create("c", client="client.c").finish()    # forces folds
+    op_a.finish()
+    d = t.dump_clients()
+    assert any(r["client"] == ClientTable.OTHER for r in d["clients"])
+    assert all(r["in_flight"] == 0 for r in d["clients"]), d["clients"]
+    assert d["num_clients"] <= 2
+
+
+def test_tracked_op_age_is_monotonic_not_wall_clock(monkeypatch):
+    """The satellite audit: a wall-clock step (NTP, VM migration) must
+    never show up in op age/duration — only the monotonic _t0 does."""
+    import time as _time
+    trk = OpTracker(slow_threshold=1.0)
+    op = trk.create("op")
+    # jump the wall clock an hour forward: duration must not notice
+    real_time = _time.time
+    monkeypatch.setattr(_time, "time", lambda: real_time() + 3600.0)
+    assert op.duration < 1.0
+    op.finish()
+    assert trk.slow_count == 0                # no phantom slow op
+    assert trk.historic[-1].to_dict()["age"] < 1.0
+
+
+def test_classify_ops():
+    assert classify_ops([{"op": "read"}]) == "read"
+    assert classify_ops([{"op": "write_full"}]) == "write"
+    assert classify_ops([{"op": "stat"}, {"op": "read"}]) == "read"
+    assert classify_ops([{"op": "create"}, {"op": "read"}]) == "write"
+    assert classify_ops([{"op": "notify"}]) == "other"
+    assert classify_ops([{"op": "watch"}]) == "other"
+
+
+# -- identity plumbing --------------------------------------------------------
+
+def test_mosdop_stamp_survives_memoryview_rx():
+    """The MOSDOp client/tenant stamps must decode bit-identically off
+    the zero-copy receive path (PR 9): payload via memoryview segments,
+    data still a zero-copy view."""
+    payload = {"tid": 7, "pgid": [1, 3], "oid": "o",
+               "ops": [{"op": "write_full", "oid": "o"}],
+               "reqid": [123, 9], "epoch": 4,
+               "client": "client.stampme", "tenant": "gold"}
+    data = bytes(range(256)) * 16
+    msg = MOSDOp(dict(payload), data)
+    msg.seq = 1
+    wire = Frame(Tag.MESSAGE, msg.encode_segments()).encode()
+
+    async def parse(buf):
+        reader = asyncio.StreamReader()
+        reader.feed_data(buf)
+        reader.feed_eof()
+        return await Frame.read(reader)
+
+    frame = run(parse(wire))
+    got = Message.decode_segments(frame.segments)
+    assert isinstance(got, MOSDOp)
+    assert got.payload == payload              # stamps bit-identical
+    assert isinstance(got.data, memoryview)    # rx path stayed zero-copy
+    assert bytes(got.data) == data
+
+
+def test_handshake_identity_survives_reconnect():
+    """The negotiated entity name + tenant live on the acceptor-side
+    session across a transport fault + RECONNECT (identity is per
+    SESSION, not per TCP transport)."""
+    async def body():
+        server = Messenger("osd.9")
+        await server.bind("127.0.0.1", 0)
+        client = Messenger("client.swtest", tenant="gold")
+        conn = await client.connect(server.my_addr,
+                                    Policy.lossless_peer())
+        conn.send_message(MPing({"i": 0}))
+        deadline = asyncio.get_running_loop().time() + 10
+        while not server._sessions:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        (srv_conn,) = server._sessions.values()
+        assert srv_conn.peer_name == "client.swtest"
+        assert srv_conn.peer_tenant == "gold"
+        # kill the transport: the lossless initiator reconnects, and
+        # the SAME acceptor session keeps its negotiated identity
+        gen = srv_conn._gen
+        conn._writer.close()
+        while srv_conn._gen == gen or not srv_conn.connected:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.02)
+        assert server._sessions and \
+            list(server._sessions.values())[0] is srv_conn
+        assert srv_conn.peer_name == "client.swtest"
+        assert srv_conn.peer_tenant == "gold"
+        await client.shutdown()
+        await server.shutdown()
+    run(body())
+
+
+# -- cluster end-to-end -------------------------------------------------------
+
+def test_cluster_per_client_accounting_and_dump(tmp_path):
+    """Ops/bytes/latency land in the primary's ClientTable under the
+    handshake identity; `dump_clients` (admin socket) serves the table;
+    a tight SLO turns ops into violations + health metrics."""
+    async def body():
+        c = ClusterHarness(tmp_path)
+        await c.start()
+        cl = RadosClient(c.mon_addrs, name="acct", tenant="gold")
+        await cl.connect()
+        c.clients.append(cl)
+        try:
+            await cl.pool_create("p", pg_num=1, size=3)
+            io = cl.ioctx("p")
+            payload = b"y" * 4096
+            for i in range(5):
+                await io.write_full(f"o{i}", payload)
+            got = await io.read("o0")
+            assert got == payload
+            prim = next(o for o in c.osds.values()
+                        if any(pg.is_primary() and pg.pool.name == "p"
+                               for pg in o.pgs.values()))
+            d = prim.optracker.clients.dump_clients()
+            row = next(r for r in d["clients"]
+                       if r["client"] == "client.acct")
+            assert row["tenant"] == "gold"
+            assert row["write_ops"] == 5
+            assert row["written_bytes"] == 5 * 4096
+            assert row["read_ops"] == 1
+            assert row["read_bytes"] == 4096
+            assert row["write_ms"]["p99"] > 0
+            assert row["in_flight"] == 0
+            # hot SLO: every subsequent write violates a 0.001ms SLO
+            prim.config.set("slo_write_ms", 0.001)
+            assert prim.optracker.clients.slo_write_s > 0
+            await io.write_full("slow", payload)
+            d = prim.optracker.clients.dump_clients()
+            row = next(r for r in d["clients"]
+                       if r["client"] == "client.acct")
+            assert row["slo"]["violations"] >= 1
+            hm = prim.optracker.clients.health_metrics()
+            assert hm["recent_violations"] >= 1
+            assert hm["violating_clients"][0]["client"] == "client.acct"
+            # ...and the OSD's mgr health surface carries it
+            assert prim._mgr_health_metrics()["clients"][
+                "recent_violations"] >= 1
+        finally:
+            await c.stop()
+    run(body())
+
+
+@pytest.mark.parametrize("pool", ["replicated", "erasure"])
+def test_dup_replay_does_not_double_count_bytes(tmp_path, pool):
+    """The dup-op satellite: an injected reply drop makes the client
+    resend; the retry is answered from the pg log's dup index and must
+    charge ZERO additional written bytes to the client."""
+    from ceph_tpu.qa import faultinject
+    from tests.test_ec_rmw import make_ec_cluster
+
+    async def body():
+        if pool == "erasure":
+            c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3)
+            pool_name = "ecpool"
+        else:
+            c = ClusterHarness(tmp_path)
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=1, size=3)
+            io = cl.ioctx("rbd")
+            pool_name = "rbd"
+        try:
+            await io.write_full("o", b"base")
+            payload = b"+tail"
+
+            def written(client_name):
+                total = 0
+                for o in c.osds.values():
+                    for r in o.optracker.clients.dump_clients()[
+                            "clients"]:
+                        if r["client"] == client_name:
+                            total += r["written_bytes"]
+                return total
+
+            before = written(cl.name)
+            faultinject.reset(seed=1)
+            faultinject.set_enabled(True)
+            try:
+                faultinject.arm_oneshot(entity="client",
+                                        msg_type="MOSDOpReply",
+                                        action="drop", count=1)
+                p, _ = await cl.submit(
+                    pool_name, "o", [{"op": "append", "oid": "o"}],
+                    payload, attempt_timeout=0.5)
+            finally:
+                faultinject.set_enabled(False)
+                faultinject.reset()
+            assert p["results"][0]["out"].get("dup"), p
+            assert await io.read("o") == b"base" + payload
+            # two executions (original + replay) but ONE byte charge
+            assert written(cl.name) - before == len(payload)
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_dump_clients_admin_socket_verb(tmp_path):
+    """The `dump_clients` admin-socket command serves the OSD's table
+    (registered at daemon construction, no cluster needed)."""
+    from ceph_tpu.osd.daemon import OSD
+    osd = OSD(42, [("127.0.0.1", 1)],
+              admin_socket_path=str(tmp_path / "osd.asok"))
+    try:
+        op = osd.optracker.create("w", client="client.verb",
+                                  tenant="t0")
+        op.kind, op.wr_bytes = "write", 128
+        op.finish()
+        out = osd.asok.execute({"prefix": "dump_clients"})["result"]
+        assert out["num_clients"] == 1
+        assert out["clients"][0]["client"] == "client.verb"
+        assert out["clients"][0]["written_bytes"] == 128
+        # the SLO knobs ride the same config surface, hot
+        osd.asok.execute({"prefix": "config set", "key": "slo_read_ms",
+                          "value": 25.0})
+        assert osd.optracker.clients.slo_read_s == 0.025
+        assert out["clients"][0]["tenant"] == "t0"
+    finally:
+        PerfCountersCollection.instance().remove("osd.42")
+        PerfCountersCollection.instance().remove("osd.42.clients")
+
+
+# -- mgr merge + exporter -----------------------------------------------------
+
+def _client_report(daemon, clients):
+    return {"daemon_name": daemon, "service": "osd", "schema": {},
+            "counters": {}, "daemon_status": {}, "health_metrics": {},
+            "progress": [], "client_metrics": clients}
+
+
+def _tallies(ops=1, rd=0, wr=0, viol=0, buckets=None, tenant=None):
+    return {"tenant": tenant, "ops": ops, "read_ops": 0,
+            "write_ops": ops, "read_bytes": rd, "written_bytes": wr,
+            "in_flight": 0, "slo_good": max(0, ops - viol),
+            "slo_violations": viol,
+            "read_buckets": {}, "write_buckets": buckets or {}}
+
+
+def test_client_aggregate_merges_across_osds():
+    """A client striped over two OSDs merges: sums for the ledgers,
+    bucket-wise histogram addition for an honest cross-cluster p99."""
+    index = DaemonStateIndex()
+    # osd.0: 90 fast ops in bucket 2^10 µs; osd.1: 10 slow in 2^16 µs
+    index.report(_client_report("osd.0", {
+        "client.a": _tallies(ops=90, wr=9000, tenant="gold",
+                             buckets={"10": 90})}))
+    index.report(_client_report("osd.1", {
+        "client.a": _tallies(ops=10, wr=1000, viol=10,
+                             buckets={"16": 10})}))
+    agg = index.client_aggregate()
+    a = agg["client.a"]
+    assert a["ops"] == 100 and a["written_bytes"] == 10000
+    assert a["slo_violations"] == 10
+    assert a["tenant"] == "gold"
+    # p99 over the MERGED histogram: the 99th of 100 samples falls in
+    # the slow bucket -> upper bound 2^17 us = 131.072 ms
+    assert a["write_lat_p99_ms"] == pytest.approx(131.072)
+
+
+def test_exporter_client_families_lint_and_cap():
+    """ceph_client_* families render with ceph_client+tenant labels,
+    exactly one # TYPE per family, and the mgr_max_client_series cap
+    folds overflow into ceph_client="_other" without losing ops."""
+    import re
+    index = DaemonStateIndex()
+    index.report(_client_report("osd.0", {
+        f"client.c{i:03d}": _tallies(ops=1000 - i, wr=100,
+                                     buckets={"12": 10})
+        for i in range(10)}))
+    text = render_metrics(index=index, max_client_series=4)
+    series = sorted(set(re.findall(r'ceph_client="([^"]+)"', text)))
+    assert len(series) == 4 and "_other" in series
+    # top clients by ops survive the cap
+    assert "client.c000" in series and "client.c001" in series
+    # nothing dropped: ops sum across rows == the 10 clients' total
+    ops_rows = [int(float(ln.rsplit(" ", 1)[1]))
+                for ln in text.splitlines()
+                if ln.startswith("ceph_client_ops{")]
+    assert sum(ops_rows) == sum(1000 - i for i in range(10))
+    # tenant label always present; p99 gauge family rendered
+    assert re.search(r'ceph_client_ops\{ceph_client="client\.c000",'
+                     r'tenant=""\} \d+', text)
+    assert "# TYPE ceph_client_write_lat_p99_ms gauge" in text
+    # lint: exactly one # TYPE per family, all sample names legal
+    sample_re = re.compile(r"^ceph_[a-z0-9_]+(_bucket|_sum|_count)?\{")
+    type_lines = [ln.split()[2] for ln in text.splitlines()
+                  if ln.startswith("# TYPE ")]
+    assert len(type_lines) == len(set(type_lines))
+    for ln in text.splitlines():
+        if not ln.startswith("#"):
+            assert sample_re.match(ln), ln
+
+
+def test_mgr_digest_slo_checks():
+    """Daemon client-health metrics digest into SLO_VIOLATIONS (recent,
+    self-clearing) and SLOW_CLIENT (p99 far over SLO)."""
+    mgr = MgrDaemon.__new__(MgrDaemon)     # digest logic only, no I/O
+    mgr.name = "x"
+    mgr.daemon_index = DaemonStateIndex()
+    mgr.daemon_index.report({
+        "daemon_name": "osd.0", "service": "osd", "schema": {},
+        "counters": {}, "daemon_status": {}, "progress": [],
+        "health_metrics": {"clients": {
+            "tracked": 3, "recent_violations": 7,
+            "violating_clients": [{"client": "client.a", "recent": 7}],
+            "slow_clients": [{"client": "client.b", "kind": "read",
+                              "p99_ms": 900.0, "slo_ms": 50.0}]}}})
+    checks = mgr._build_digest()["checks"]
+    assert checks["SLO_VIOLATIONS"]["severity"] == "HEALTH_WARN"
+    assert "7 client SLO violations" in \
+        checks["SLO_VIOLATIONS"]["summary"]
+    assert checks["SLO_VIOLATIONS"]["detail"] == \
+        ["client.a: 7 recent violations"]
+    assert checks["SLOW_CLIENT"]["severity"] == "HEALTH_WARN"
+    assert "client.b" in checks["SLOW_CLIENT"]["detail"][0]
+    # quiet clients -> both checks clear
+    mgr.daemon_index.report({
+        "daemon_name": "osd.0", "service": "osd", "schema": {},
+        "counters": {}, "daemon_status": {}, "progress": [],
+        "health_metrics": {"clients": {"tracked": 3,
+                                       "recent_violations": 0,
+                                       "violating_clients": [],
+                                       "slow_clients": []}}})
+    checks = mgr._build_digest()["checks"]
+    assert "SLO_VIOLATIONS" not in checks
+    assert "SLOW_CLIENT" not in checks
+
+
+def test_perf_reset_clears_client_tables_and_buckets(tmp_path):
+    """The perf-reset satellite: after admin-socket `perf reset`, a
+    fresh exporter scrape shows EMPTY histogram buckets and a zeroed
+    client table — reset must reach bucket arrays and the per-client
+    tables, not just scalar counters."""
+    coll = PerfCountersCollection.instance()
+    coll.remove("resetscrape.test")
+    coll.remove("resetscrape.clients")
+    pc = coll.create("resetscrape.test")
+    pc.add("h_us", type=TYPE_HISTOGRAM)
+    pc.hist_add("h_us", 300.0)
+    table = ClientTable("resetscrape.clients")
+    coll.register(table)
+    trk = OpTracker(clients=table)
+    op = trk.create("w", client="client.r")
+    op.kind, op.wr_bytes = "write", 512
+    op.finish()
+    asok = AdminSocket(str(tmp_path / "asok"))
+    try:
+        text = render_metrics()      # local-registry fallback scrape
+        assert 'ceph_h_us_bucket{ceph_daemon="resetscrape.test",' \
+               'le="512"} 1' in text
+        assert 'ceph_client_ops{ceph_daemon="resetscrape.clients"} 1' \
+            in text
+        out = asok.execute({"prefix": "perf reset"})
+        assert "resetscrape.test" in out["result"]["reset"]
+        assert "resetscrape.clients" in out["result"]["reset"]
+        text = render_metrics()
+        # cumulative bucket rows vanish (no buckets recorded), count=0
+        assert 'ceph_h_us_bucket{ceph_daemon="resetscrape.test",' \
+               'le="512"}' not in text
+        assert 'ceph_h_us_count{ceph_daemon="resetscrape.test"} 0' \
+            in text
+        assert 'ceph_client_ops{ceph_daemon="resetscrape.clients"} 0' \
+            in text
+        assert table.dump_clients()["num_clients"] == 0
+    finally:
+        coll.remove("resetscrape.test")
+        coll.remove("resetscrape.clients")
+
+
+# -- swarm harness ------------------------------------------------------------
+
+def test_swarm_smoke(tmp_path):
+    """A small swarm (16 clients incl. slow readers) against an EC
+    pool: per-client p99s, the fairness ratio, zero errors, and every
+    client identity visible in the OSDs' accounting tables."""
+    from ceph_tpu.tools.cluster_boot import ephemeral_cluster
+    from ceph_tpu.tools.rados_swarm import run_swarm
+
+    async def body():
+        async with ephemeral_cluster(3, prefix="swarm-test-") \
+                as (client, osds, mon):
+            await client.command({
+                "prefix": "osd erasure-code-profile set",
+                "name": "sprof",
+                "profile": {"plugin": "jerasure", "k": "2", "m": "1"}})
+            await client.pool_create("swarm", pg_num=4,
+                                     pool_type="erasure",
+                                     erasure_code_profile="sprof")
+            out = await run_swarm(
+                list(mon.monmap.mons.values()), "swarm",
+                clients=16, seconds=1.5, objects=24, slow_readers=2,
+                connect_batch=8, client_prefix="sm")
+            assert out["clients"] == 16 and out["errors"] == 0
+            assert out["ops"] > 0 and out["mb_s"] > 0
+            assert out["p99_fairness"] >= 1.0
+            assert len(out["per_client"]) == 16
+            assert all(s["p99_ms"] > 0
+                       for s in out["per_client"].values())
+            # slow readers carry the injected tenant tag
+            assert sum(1 for s in out["per_client"].values()
+                       if s["tenant"] == "slowband") == 2
+            # every swarm identity was accounted by some OSD
+            seen = set()
+            for o in osds:
+                seen |= {r["client"] for r in
+                         o.optracker.clients.dump_clients()["clients"]}
+            assert {f"client.sm{i:04d}" for i in range(16)} <= seen
+    run(body())
