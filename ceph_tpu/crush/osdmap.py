@@ -154,11 +154,27 @@ class OSDMap:
         self.pool_names: dict[str, int] = {}
         self.pg_temp: dict[PG, list[int]] = {}
         self.ec_profiles: dict[str, dict] = {}
+        # raw-placement memo: the full straw2 walk per op showed up as
+        # ~7% of a busy OSD loop (every client submit and every sub-op
+        # handler recomputes its PG's mapping). Raw placement depends
+        # only on the crush map + pool defs + weight vector — all of
+        # which change with the epoch or through the explicit mutators
+        # below, each of which drops the memo. Up/down state is NOT
+        # part of raw placement (pg_to_up_acting filters it per call),
+        # so mark-downs stay visible instantly with a warm memo.
+        self._raw_memo: dict[PG, list[int]] = {}
+        self._raw_memo_epoch = -1
+
+    def _placement_changed(self) -> None:
+        """Drop the raw-placement memo (weights/pools/crush mutated)."""
+        self._raw_memo.clear()
+        self._raw_memo_epoch = self.epoch
 
     # -- membership ----------------------------------------------------------
 
     def add_osd(self, osd: int, addr: str = "") -> None:
         self.osds[osd] = OsdState(addr=addr)
+        self._placement_changed()
 
     def set_up(self, osd: int, up: bool, addr: str | None = None) -> None:
         state = self.osds[osd]
@@ -168,9 +184,11 @@ class OSDMap:
 
     def set_in(self, osd: int, in_cluster: bool) -> None:
         self.osds[osd].in_cluster = in_cluster
+        self._placement_changed()
 
     def reweight(self, osd: int, weight: float) -> None:
         self.osds[osd].weight = max(0.0, min(1.0, weight))
+        self._placement_changed()
 
     def is_up(self, osd: int) -> bool:
         return osd in self.osds and self.osds[osd].up
@@ -187,6 +205,7 @@ class OSDMap:
         pool = Pool(id=pid, name=name, **kwargs)
         self.pools[pid] = pool
         self.pool_names[name] = pid
+        self._placement_changed()
         return pool
 
     def get_pool(self, ref: int | str) -> Pool:
@@ -207,10 +226,18 @@ class OSDMap:
                 for osd, s in self.osds.items()}
 
     def pg_to_raw_osds(self, pg: PG) -> list[int]:
-        pool = self.pools[pg.pool]
-        x = _pg_seed(pg.pool, pg.ps)
-        return self.crush.do_rule(pool.crush_rule, x, pool.size,
-                                  self._weights())
+        if self._raw_memo_epoch != self.epoch:
+            # epoch moved (incrementals, load_dict, mon commits): any
+            # of crush/pools/weights may have changed with it
+            self._raw_memo.clear()
+            self._raw_memo_epoch = self.epoch
+        raw = self._raw_memo.get(pg)
+        if raw is None:
+            pool = self.pools[pg.pool]
+            x = _pg_seed(pg.pool, pg.ps)
+            raw = self._raw_memo[pg] = self.crush.do_rule(
+                pool.crush_rule, x, pool.size, self._weights())
+        return raw
 
     def pg_to_up_acting_osds(self, pg: PG) -> tuple[list[int], list[int]]:
         """(up, acting): raw mapping with down osds removed (holes stay for

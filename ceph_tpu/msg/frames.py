@@ -27,6 +27,7 @@ provided by the in-repo C++ kernel (native/ec_native.cc).
 from __future__ import annotations
 
 import enum
+import os
 import struct
 import time
 import zlib
@@ -39,6 +40,52 @@ MAGIC = 0xEC02
 MAX_SEGMENTS = 4
 _PRE_FIXED = struct.Struct("<HBB")
 _U32 = struct.Struct("<I")
+
+# -- native frame codec selection --------------------------------------------
+# The frame hot path (preamble pack/parse + the crc32c-over-scatter-list
+# pass) runs as ONE GIL-releasing C call when native/ec_native.cc is
+# available; the pure-Python path below stays the bit-identical fallback
+# (and the reference the fuzz tests hold the native codec to). Chosen at
+# import like the ec_native probe; CEPH_TPU_FRAME_NATIVE=0 force-disables
+# (the tier-1 fallback suite runs under exactly that).
+_frame_native = None
+if os.environ.get("CEPH_TPU_FRAME_NATIVE", "1") != "0":
+    try:
+        from ceph_tpu.native import frame_native as _fn_mod
+        if _fn_mod.available():
+            _frame_native = _fn_mod
+    except Exception:
+        _frame_native = None
+
+
+def native_active() -> bool:
+    """True when frames encode/verify through the native codec."""
+    return _frame_native is not None
+
+
+def set_native(enabled: bool) -> bool:
+    """Select the frame codec at runtime (tests/bench A-B the two
+    paths); returns the resulting native_active(). Enabling is a no-op
+    when the native library is unavailable."""
+    global _frame_native
+    if not enabled:
+        _frame_native = None
+        return False
+    try:
+        from ceph_tpu.native import frame_native as _fn_mod
+        _frame_native = _fn_mod if _fn_mod.available() else None
+    except Exception:
+        _frame_native = None
+    return _frame_native is not None
+
+
+def _seg_len(seg) -> int:
+    """Byte length of a segment; scatter segments (a list/tuple of
+    bytes-likes, e.g. the sub-op batch envelope's concatenated message
+    datas) count the sum of their parts."""
+    if isinstance(seg, (list, tuple)):
+        return sum(len(p) for p in seg)
+    return len(seg)
 
 # trace-context TLV segment (the Message.h otel_trace analog): an
 # OPTIONAL trailing frame segment `magic u16 | trace_id u64 | span_id
@@ -97,34 +144,70 @@ class Frame:
         """Wire form as a scatter list: [preamble, seg0, crc0, seg1,
         crc1, ...] — the preamble/crc trailers are fresh small bytes,
         every segment is passed BY REFERENCE (no ledger accounting
-        here; encode/encode_parts meter their own copy behavior)."""
+        here; encode/encode_parts meter their own copy behavior).
+        Scatter segments flatten into consecutive parts under one
+        chained crc — their bytes never join before the transport."""
         if not 0 <= len(self.segments) <= MAX_SEGMENTS:
             raise FrameError(f"{len(self.segments)} segments (max "
                              f"{MAX_SEGMENTS})")
         pre = bytearray(_PRE_FIXED.pack(MAGIC, int(self.tag),
                                         len(self.segments)))
         for seg in self.segments:
-            pre += _U32.pack(len(seg))
+            pre += _U32.pack(_seg_len(seg))
         pre += _U32.pack(crc32c(bytes(pre)))
         parts: list = [bytes(pre)]
         for seg in self.segments:
-            parts.append(seg)
-            parts.append(_U32.pack(crc32c(seg)))
+            if isinstance(seg, (list, tuple)):
+                crc = 0
+                for p in seg:
+                    parts.append(p)
+                    crc = crc32c(p, crc)
+                parts.append(_U32.pack(crc))
+            else:
+                parts.append(seg)
+                parts.append(_U32.pack(crc32c(seg)))
         return parts
+
+    def _payload_len(self) -> int:
+        return sum(_seg_len(s) for s in self.segments)
 
     def encode_parts(self) -> list:
         """Scatter-gather wire form for the plain-crc transport path:
         the write loop hands these buffers to the transport
         (writelines), whose single outbound join is the ONE copy each
         segment pays — down from two in the old assemble-then-bytes()
-        encode(). Metered as one tx copy; the Onwire modes still need
-        the contiguous blob (they transform whole frames) and use
-        encode()."""
+        encode(). Metered as one tx copy either way; with the native
+        codec the preamble build + every crc pass + the single copy
+        happen in ONE GIL-releasing C call and the transport gets the
+        finished blob."""
+        if _frame_native is not None:
+            if not 0 <= len(self.segments) <= MAX_SEGMENTS:
+                raise FrameError(f"{len(self.segments)} segments (max "
+                                 f"{MAX_SEGMENTS})")
+            t0 = time.perf_counter()
+            blob = _frame_native.pack(MAGIC, int(self.tag), self.segments)
+            copytrack.copied("frame_tx", self._payload_len(),
+                             time.perf_counter() - t0)
+            return [blob]
         parts = self._parts()
-        copytrack.copied("frame_tx", sum(len(s) for s in self.segments))
+        copytrack.copied("frame_tx", self._payload_len())
         return parts
 
-    def encode(self) -> bytes:
+    def encode(self) -> bytes | bytearray:
+        if _frame_native is not None:
+            # the packed bytearray is returned AS-IS (bytes-like):
+            # every consumer — transport write, Onwire compress/
+            # encrypt/concat — takes a buffer, and a bytes() round
+            # trip here would re-copy the whole frame on exactly the
+            # hot path the native codec exists to shrink
+            t0 = time.perf_counter()
+            if not 0 <= len(self.segments) <= MAX_SEGMENTS:
+                raise FrameError(f"{len(self.segments)} segments (max "
+                                 f"{MAX_SEGMENTS})")
+            blob = _frame_native.pack(MAGIC, int(self.tag), self.segments)
+            copytrack.copied("frame_tx", self._payload_len(),
+                             time.perf_counter() - t0)
+            return blob
         # crcs/preamble are built OUTSIDE the timed window: the
         # ledger's frame_tx seconds must meter byte movement only, or a
         # zero-copy change that leaves CRC alone under-reports its win
@@ -133,7 +216,7 @@ class Frame:
         blob = b"".join(parts)
         # one join: each segment byte is copied exactly once into the
         # wire blob (the old bytearray-accumulate + bytes() paid twice)
-        copytrack.copied("frame_tx", sum(len(s) for s in self.segments),
+        copytrack.copied("frame_tx", self._payload_len(),
                          time.perf_counter() - t0)
         return blob
 
@@ -171,7 +254,29 @@ class Frame:
                         body: memoryview) -> list[memoryview]:
         """crc-verify and window each segment out of the body buffer —
         zero-copy: every returned segment is a view, and the buffer
-        stays alive exactly as long as any segment does (refcounted)."""
+        stays alive exactly as long as any segment does (refcounted).
+        With the native codec the whole crc-over-segments pass is one
+        GIL-releasing C call; the view windowing stays in Python."""
+        want = sum(ln + 4 for ln in seg_lens)
+        if len(body) < want:
+            raise FrameError("truncated segment")
+        if _frame_native is not None:
+            base = body.obj if isinstance(body, memoryview) else None
+            # the streamed-read path hands a view over EXACTLY the body
+            # bytes: pass the bytes object itself (ctypes converts it
+            # without the numpy fallback the sliced decode path needs)
+            buf = base if type(base) is bytes and len(base) == want \
+                else body[:want]
+            bad = _frame_native.verify_body(buf, seg_lens)
+            if bad >= 0:
+                raise FrameError("segment crc mismatch")
+            segments = []
+            off = 0
+            for ln in seg_lens:
+                segments.append(body[off:off + ln])
+                off += ln + 4
+            copytrack.referenced("frame_rx", sum(seg_lens))
+            return segments
         try:
             segments: list[memoryview] = []
             off = 0

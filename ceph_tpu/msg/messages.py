@@ -177,6 +177,99 @@ MOSDECSubOpWriteReply = _simple(0x71, "MOSDECSubOpWriteReply")
 MOSDECSubOpRead = _simple(0x72, "MOSDECSubOpRead")
 MOSDECSubOpReadReply = _simple(0x73, "MOSDECSubOpReadReply", data_view=True)
 
+# -- per-peer sub-op coalescing (this framework's jumbo frame; no direct
+# reference analog — the reference amortizes per-message cost with
+# throttled byte streams, we amortize per-FRAME Python) ----------------------
+# A batch is a transport-level envelope: the messenger's write loop
+# packs data-plane messages already queued for the same peer into ONE
+# frame (one preamble, one crc pass over the concatenated datas, one
+# dispatch on the far side), and the receive side unpacks them back
+# into the original typed messages BEFORE seq accounting — each inner
+# message keeps its own connection seq, so the dup filter, replay after
+# reconnect, pg-log and rollback semantics are untouched. The envelope
+# itself never enters the replay buffer (its inner messages do).
+MOSDECSubOpBatch = _simple(0x74, "MOSDECSubOpBatch", data_view=True)
+MOSDECSubOpBatchReply = _simple(0x75, "MOSDECSubOpBatchReply",
+                                data_view=True)
+
+#: message types the write loop may coalesce into a batch envelope:
+#: the EC data plane (sub-ops + replies), replication sub-ops, recovery
+#: pushes, and the client I/O plane. Control-plane traffic (maps,
+#: paxos, mgr reports, heartbeats) never batches — a linger window on
+#: an osdmap would slow every failure detection for no byte win.
+BATCH_REPLY_TYPES = frozenset((
+    MOSDECSubOpWriteReply.TYPE, MOSDECSubOpReadReply.TYPE,
+    MOSDRepOpReply.TYPE, MOSDPGPushReply.TYPE, MOSDOpReply.TYPE))
+BATCHABLE_TYPES = frozenset((
+    MOSDECSubOpWrite.TYPE, MOSDECSubOpRead.TYPE, MOSDRepOp.TYPE,
+    MOSDPGPush.TYPE, MOSDOp.TYPE)) | BATCH_REPLY_TYPES
+
+
+def pack_batch(msgs: list) -> Message:
+    """Envelope `msgs` (each already seq-stamped) into one batch
+    message. Inner payloads/seqs/trace contexts ride the envelope's
+    payload; inner datas become a SCATTER data segment (a list the
+    frame codec crc-chains and the transport writes without an
+    intermediate join — zero-copy all the way to the wire)."""
+    entries = []
+    datas: list = []
+    for m in msgs:
+        e = {"t": m.TYPE, "s": m.seq, "p": m.payload, "n": len(m.data)}
+        if m.trace is not None:
+            e["tr"] = m.trace
+        entries.append(e)
+        if len(m.data):
+            datas.append(m.data)
+    cls = MOSDECSubOpBatchReply \
+        if all(m.TYPE in BATCH_REPLY_TYPES for m in msgs) \
+        else MOSDECSubOpBatch
+    batch = cls({"msgs": entries}, datas)
+    # the envelope rides the LAST inner seq so a peer that somehow saw
+    # it as a plain message would not regress its dup filter; receivers
+    # that know the type do per-inner-message seq accounting instead
+    batch.seq = msgs[-1].seq
+    return batch
+
+
+def unpack_batch(msg: Message) -> list:
+    """Inner messages of a batch envelope, data segments as zero-copy
+    windows over the envelope's data. Undecodable entries (unknown
+    type id from a newer peer, malformed record) are dropped
+    INDIVIDUALLY — partial-batch error isolation: one bad entry must
+    not lose its batch-mates."""
+    data = msg.data
+    if isinstance(data, list):
+        # a locally-packed envelope that never crossed the wire (tests,
+        # loopback): its data is still the scatter list
+        data = b"".join(bytes(p) for p in data)
+    out = []
+    off = 0
+    for e in msg.payload.get("msgs", ()):
+        try:
+            n = int(e["n"])
+        except (KeyError, TypeError, ValueError):
+            break       # data-offset alignment lost: stop, don't guess
+        seg = data[off:off + n] if n else b""
+        off += n
+        try:
+            cls = _REGISTRY.get(e["t"])
+            if cls is None:
+                continue                # unknown type: skip, keep going
+            if not cls.DATA_VIEW and not isinstance(seg,
+                                                    (bytes, bytearray)):
+                t0 = time.perf_counter()
+                seg = bytes(seg)
+                copytrack.copied("frame_rx", len(seg),
+                                 time.perf_counter() - t0)
+            m = cls.__new__(cls)
+            Message.__init__(m, e["p"], seg)
+            m.seq = int(e["s"])
+            m.trace = e.get("tr")
+            out.append(m)
+        except (KeyError, TypeError, ValueError):
+            continue
+    return out
+
 # -- watch/notify (MWatchNotify, src/messages/MWatchNotify.h) ----------------
 MWatchNotify = _simple(0x90, "MWatchNotify")        # osd -> watcher client:
                                                     # {"oid", "notify_id",

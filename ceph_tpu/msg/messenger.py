@@ -36,9 +36,11 @@ import hashlib
 import hmac
 import json
 import os
+import threading
 import time
 from typing import Awaitable, Callable
 
+from ceph_tpu.msg import messages as _messages
 from ceph_tpu.msg.frames import BANNER, Frame, FrameError, Tag, Onwire
 from ceph_tpu.msg.messages import Message, _json_seg
 from ceph_tpu.qa import faultinject
@@ -46,6 +48,112 @@ from ceph_tpu.utils import tracer
 from ceph_tpu.utils.async_util import being_cancelled, drain_all, reap, \
     reap_all
 from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.perf_counters import (TYPE_HISTOGRAM,
+                                          PerfCountersCollection)
+
+# -- per-peer message batching (msgr_batch_*) --------------------------------
+# The sub-op fan-out seam: one client EC write fans k+m MOSDECSubOpWrite
+# frames out (and k+m replies back), each paying a full preamble +
+# crc + dispatch in per-frame Python. Under concurrency, sub-ops bound
+# for the SAME peer pile up in a connection's outbound queue faster
+# than the write loop drains them — so the write loop coalesces
+# consecutive data-plane messages into one batch envelope
+# (messages.pack_batch) within a linger window, the offload batcher's
+# size-bucket + linger-deadline discipline applied to the wire. Module
+# defaults mirror the ec_offload_* pattern: hot-togglable through any
+# daemon's config observer, read by every connection per batch decision.
+
+_BATCH_DEFAULTS: dict = {
+    "enabled": True,
+    "max_bytes": 1 << 20,
+    # 0 = greedy: batch whatever is already queued plus two event-loop
+    # yields, no timer. MEASURED on the bench container: any timed
+    # linger (even 100µs) costs more in wait_for timer churn + added
+    # serial latency than the extra coalescing wins at cluster op
+    # rates; the knob stays for high-rate or high-latency links.
+    "linger_us": 0.0,
+}
+
+_msgr_perf_lock = threading.Lock()
+
+
+def msgr_perf():
+    """The process-wide "msgr" perf logger (frame/batch counters),
+    created on first use; rides `perf dump`, the MgrClient report
+    stream (extra_loggers), and the exporter like any other logger.
+    Locked: shard loops race the first-use registration, and a second
+    caller must never see a half-added counter set."""
+    coll = PerfCountersCollection.instance()
+    with _msgr_perf_lock:
+        pc = coll.get("msgr")
+        if pc is not None:
+            return pc
+        pc = coll.create("msgr")
+        pc.add("frames_tx",
+               description="MESSAGE frames written to the wire")
+        pc.add("frames_rx",
+               description="MESSAGE frames read off the wire")
+        pc.add("data_frames_tx",
+               description="data-plane MESSAGE frames written (client "
+                           "I/O, EC/replication sub-ops + replies, "
+                           "recovery pushes, batch envelopes) — the "
+                           "numerator of frames-per-client-write")
+        pc.add("batches_tx",
+               description="batch envelopes written (each replaces N "
+                           "data-plane frames with one)")
+        pc.add("batched_msgs",
+               description="messages that rode a batch envelope "
+                           "instead of their own frame")
+        pc.add("batch_ops", type=TYPE_HISTOGRAM,
+               description="messages coalesced per batch envelope")
+        return pc
+
+
+def MSGR_OPTIONS():
+    """The msgr_batch_* option schema (declared per daemon Config)."""
+    from ceph_tpu.utils.config import Option
+    return [
+        Option("msgr_batch_enabled", "bool", _BATCH_DEFAULTS["enabled"],
+               "coalesce queued data-plane messages bound for the same "
+               "peer into one batch frame (false = one frame per "
+               "message)"),
+        Option("msgr_batch_max_bytes", "size",
+               _BATCH_DEFAULTS["max_bytes"],
+               "flush a per-peer message batch at this many payload "
+               "bytes", minimum=4096),
+        Option("msgr_batch_linger_us", "float",
+               _BATCH_DEFAULTS["linger_us"],
+               "max time the write loop waits for batch-mates before "
+               "the frame ships anyway (µs); 0 = greedy (already-"
+               "queued messages plus two event-loop yields, no timer)",
+               minimum=0.0),
+    ]
+
+
+def register_config(config) -> None:
+    """Declare the msgr_batch_* options on `config` (idempotent) and
+    hot-apply changes to the module defaults every connection reads —
+    `config set msgr_batch_linger_us 1000` over an admin socket retunes
+    the wire batcher live, the ec_offload_* observer pattern."""
+    from ceph_tpu.utils.config import ConfigError
+    names = []
+    for opt in MSGR_OPTIONS():
+        names.append(opt.name)
+        try:
+            config.declare(opt)
+        except ConfigError:
+            pass                    # another daemon already declared it
+
+    def _on_change(name: str, value) -> None:
+        key = name[len("msgr_batch_"):]
+        if key in _BATCH_DEFAULTS:
+            _BATCH_DEFAULTS[key] = value
+
+    config.add_observer(tuple(names), _on_change)
+    diff = config.diff()
+    for name in names:
+        if name in diff:
+            _on_change(name, config.get(name))
 
 
 def _build_onwire(agreed: dict, role: str,
@@ -153,6 +261,7 @@ class Connection:
         self._writer = None
         self._gen = 0          # transport generation; bumped per _attach
         self._tasks: set[asyncio.Task] = set()
+        self._ack_timer = None     # lazy idle-ack flush (call_later)
         self._closed = False
         self._connected = asyncio.Event()
         self._last_rx = time.monotonic()
@@ -183,6 +292,9 @@ class Connection:
 
     async def close(self) -> None:
         self._closed = True
+        if self._ack_timer is not None:
+            self._ack_timer.cancel()
+            self._ack_timer = None
         tasks = list(self._tasks)   # done-callbacks mutate _tasks
         await reap_all(tasks)
         self._tasks.clear()
@@ -440,34 +552,24 @@ class Connection:
 
     async def _read_loop(self, reader, onwire: Onwire | None = None
                          ) -> None:
+        perf = self.messenger.perf
         while True:
             frame = await (onwire.read_frame(reader) if onwire
                            else Frame.read(reader))
             self._last_rx = time.monotonic()
             if frame.tag == Tag.MESSAGE:
+                perf.inc("frames_rx")
                 msg = Message.decode_segments(frame.segments)
-                if msg.seq <= self.in_seq:
-                    continue                      # replayed duplicate
-                self.in_seq = msg.seq
-                if faultinject.armed():
-                    # deterministic fault injection AFTER seq accounting:
-                    # a dropped message is permanently lost (later
-                    # dispatches advance the processed-seq ack past it,
-                    # like real on-path loss); a dup re-enters dispatch
-                    # twice (the dup-op table's exercise); a delay
-                    # reorders it behind later arrivals
-                    act, delay = faultinject.on_message(
-                        self.messenger.entity_name, msg)
-                    if act == "drop":
-                        continue
-                    if act == "dup":
-                        self._dispatch_q.put_nowait(
-                            (self._session_gen, msg))
-                    elif act == "delay":
-                        self._spawn(self._deliver_delayed(
-                            self._session_gen, msg, delay))
-                        continue
-                self._dispatch_q.put_nowait((self._session_gen, msg))
+                if isinstance(msg, (_messages.MOSDECSubOpBatch,
+                                    _messages.MOSDECSubOpBatchReply)):
+                    # batch envelope: unpack BEFORE seq accounting —
+                    # every inner message carries its own connection
+                    # seq, so dup filtering, acks, and replay behave
+                    # exactly as if each had arrived on its own frame
+                    for m in _messages.unpack_batch(msg):
+                        self._rx_message(m)
+                else:
+                    self._rx_message(msg)
             elif frame.tag == Tag.ACK:
                 (seq,) = _json_seg(frame.segments[0])
                 self._trim_sent(seq)
@@ -477,6 +579,32 @@ class Connection:
                 pass
             else:
                 raise FrameError(f"unexpected tag {frame.tag} mid-session")
+
+    def _rx_message(self, msg: Message) -> None:
+        """Seq-account and enqueue one received message (whether it
+        arrived on its own frame or inside a batch envelope)."""
+        if msg.seq <= self.in_seq:
+            return                            # replayed duplicate
+        self.in_seq = msg.seq
+        if faultinject.armed():
+            # deterministic fault injection AFTER seq accounting: a
+            # dropped message is permanently lost (later dispatches
+            # advance the processed-seq ack past it, like real on-path
+            # loss); a dup re-enters dispatch twice (the dup-op table's
+            # exercise); a delay reorders it behind later arrivals.
+            # Runs PER INNER MESSAGE of a batch, so msg-type rules keep
+            # their pre-batching semantics.
+            act, delay = faultinject.on_message(
+                self.messenger.entity_name, msg)
+            if act == "drop":
+                return
+            if act == "dup":
+                self._dispatch_q.put_nowait((self._session_gen, msg))
+            elif act == "delay":
+                self._spawn(self._deliver_delayed(
+                    self._session_gen, msg, delay))
+                return
+        self._dispatch_q.put_nowait((self._session_gen, msg))
 
     async def _deliver_delayed(self, gen: int, msg: Message,
                                delay: float) -> None:
@@ -518,26 +646,116 @@ class Connection:
                 if self._processed_seq - self._last_acked_in >= \
                         self.ACK_EVERY:
                     self._out.put_nowait(("ack", self._processed_seq))
+                else:
+                    # below the coalesce threshold: arm ONE lazy timer
+                    # that flushes the ack if the connection goes quiet
+                    # (replaces the old wait_for-per-frame idle timeout
+                    # in the write loop — same <=IDLE_ACK_S ack bound,
+                    # none of the per-frame timer churn)
+                    self._schedule_ack_flush()
 
     IDLE_ACK_S = 0.5   # flush pending acks when the queue goes quiet
 
+    def _schedule_ack_flush(self) -> None:
+        if self._ack_timer is None:
+            self._ack_timer = asyncio.get_running_loop().call_later(
+                self.IDLE_ACK_S, self._ack_flush)
+
+    def _ack_flush(self) -> None:
+        self._ack_timer = None
+        if not self._closed and \
+                self._processed_seq > self._last_acked_in:
+            self._out.put_nowait(("ack", self._processed_seq))
+
+    async def _coalesce(self, msg: Message) -> tuple[Message, tuple | None]:
+        """Per-peer message batching (the EC sub-op fan-out seam): with
+        `msg` in hand, drain whatever batchable data-plane messages are
+        already queued behind it — lingering up to msgr_batch_linger_us
+        for stragglers — and envelope them into ONE frame. Returns
+        (message to frame, leftover non-batchable item or None). Order
+        is preserved: inner messages keep queue (= seq) order, and a
+        non-batchable item that ended the drain ships right after."""
+        if not _BATCH_DEFAULTS["enabled"] or \
+                type(msg).TYPE not in _messages.BATCHABLE_TYPES:
+            return msg, None
+        # the envelope's concatenated data rides ONE frame segment, so
+        # the admission cap must also respect the receiver's segment
+        # bound — an operator raising msgr_batch_max_bytes past it
+        # would otherwise build frames every peer rejects (and lossless
+        # replay would deterministically rebuild them: a livelock)
+        max_bytes = min(_BATCH_DEFAULTS["max_bytes"],
+                        Frame.MAX_SEGMENT_SIZE)
+        linger_s = _BATCH_DEFAULTS["linger_us"] / 1e6
+        msgs = [msg]
+        nbytes = len(msg.data)
+        loop = asyncio.get_running_loop()
+        # micro-linger: a couple of plain event-loop yields let tasks
+        # that are ALREADY runnable (a PG fan-out mid-send, a handler
+        # about to reply) enqueue their messages before the frame
+        # ships. sleep(0) costs no timer — the wait_for-per-frame
+        # variant of this loop measurably LOST throughput to timer +
+        # wrapper-task churn at this op rate.
+        yields = 2
+        deadline = loop.time() + linger_s if linger_s > 0 else None
+        leftover = None
+        while nbytes < max_bytes:
+            if self._out.empty():
+                if yields > 0:
+                    yields -= 1
+                    await asyncio.sleep(0)
+                    continue
+                if deadline is None:
+                    break
+                timeout = deadline - loop.time()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = await asyncio.wait_for(self._out.get(),
+                                                 timeout)
+                except asyncio.TimeoutError:
+                    break
+            else:
+                nxt = self._out.get_nowait()
+            if nxt[0] == "msg" and \
+                    type(nxt[1]).TYPE in _messages.BATCHABLE_TYPES and \
+                    nbytes + len(nxt[1].data) <= max_bytes:
+                # size checked BEFORE admission: a message that would
+                # push the envelope past the cap ships on its own frame
+                # right after (it is legal there by itself)
+                msgs.append(nxt[1])
+                nbytes += len(nxt[1].data)
+            else:
+                leftover = nxt
+                break
+        if len(msgs) == 1:
+            return msg, leftover
+        perf = self.messenger.perf
+        perf.inc("batches_tx")
+        perf.inc("batched_msgs", len(msgs))
+        perf.hist_add("batch_ops", len(msgs))
+        return _messages.pack_batch(msgs), leftover
+
     async def _write_loop(self, writer,
                           onwire: Onwire | None = None) -> None:
+        perf = self.messenger.perf
+        pending: tuple | None = None
         while True:
-            try:
-                item = await asyncio.wait_for(self._out.get(),
-                                              timeout=self.IDLE_ACK_S)
-            except asyncio.TimeoutError:
-                # idle: tell the peer what we've PROCESSED so it trims
-                # replay (not what we've read — a cancelled handler must
-                # be replayed, not lost)
-                if self._processed_seq > self._last_acked_in:
-                    item = ("ack", self._processed_seq)
-                else:
-                    continue
+            if pending is not None:
+                item, pending = pending, None
+            else:
+                # plain get — no wait_for wrapper task + timer per
+                # frame (profiled per-frame overhead); idle acks ride
+                # the dispatch loop's lazy _schedule_ack_flush timer
+                item = await self._out.get()
             kind, arg = item
             if kind == "msg":
+                arg, pending = await self._coalesce(arg)
                 frame = Frame(Tag.MESSAGE, arg.encode_segments())
+                perf.inc("frames_tx")
+                if type(arg).TYPE in _messages.BATCHABLE_TYPES or \
+                        isinstance(arg, (_messages.MOSDECSubOpBatch,
+                                         _messages.MOSDECSubOpBatchReply)):
+                    perf.inc("data_frames_tx")
             elif kind == "ack":
                 frame = Frame(Tag.ACK, [json.dumps([arg]).encode()])
                 self._last_acked_in = arg
@@ -604,6 +822,9 @@ class Messenger:
         # key also seeds the AES-GCM onwire mode; without it, crc mode
         # (optionally compressed)
         self.auth_key = auth_key
+        # frame/batch counters (process-wide "msgr" logger shared by
+        # every messenger; the bench reads it for frames-per-write)
+        self.perf = msgr_perf()
         self.dispatchers: list[Dispatcher] = []
         self._server: asyncio.base_events.Server | None = None
         self.my_addr: tuple[str, int] | None = None

@@ -56,6 +56,18 @@ def load() -> ctypes.CDLL:
             lib.crc32c_blocks.argtypes = [u8p, ctypes.c_size_t,
                                           ctypes.c_size_t, ctypes.c_uint32,
                                           u32p]
+            # msgr2 frame codec (present in rebuilt libraries; a stale
+            # .so predating it rebuilds via the source-mtime check above)
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            if hasattr(lib, "frame_pack"):
+                lib.frame_pack.restype = ctypes.c_uint64
+                lib.frame_pack.argtypes = [
+                    ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int,
+                    u64p, ctypes.POINTER(ctypes.c_char_p), u64p,
+                    ctypes.c_void_p]
+                lib.frame_verify_body.restype = ctypes.c_int
+                lib.frame_verify_body.argtypes = [ctypes.c_void_p, u64p,
+                                                  ctypes.c_int]
             lib.ec_native_have_avx2.restype = ctypes.c_int
             lib.ec_native_have_sse42.restype = ctypes.c_int
             _lib = lib

@@ -170,14 +170,22 @@ class _InjectedDeviceFailure(RuntimeError):
 
 
 class _Job:
-    """One submitted op: a stripe/block batch plus its completion."""
+    """One submitted op: a stripe/block batch plus its completion.
+    `data` is one array, or a LIST of row-compatible arrays (a scatter
+    job — e.g. per-shard csum fragments): the fragments stack straight
+    into the staging pages at batch build, never through an
+    intermediate join on the submit path."""
 
     __slots__ = ("data", "rows", "nbytes", "fut", "span", "t_submit")
 
-    def __init__(self, data: np.ndarray, fut: asyncio.Future):
+    def __init__(self, data, fut: asyncio.Future):
         self.data = data
-        self.rows = data.shape[0]
-        self.nbytes = int(data.nbytes)
+        if isinstance(data, list):
+            self.rows = sum(f.shape[0] for f in data)
+            self.nbytes = int(sum(f.nbytes for f in data))
+        else:
+            self.rows = data.shape[0]
+            self.nbytes = int(data.nbytes)
         self.fut = fut
         self.span = tracer.start_span("offload_queue_wait")
         self.t_submit = time.perf_counter()
@@ -663,13 +671,16 @@ class OffloadService:
         return await self._submit(key, chunks, dispatch, fallback,
                                   shard_dispatch=shard_dispatch)
 
-    async def crc32c_blocks(self, blocks: np.ndarray,
-                            block_size: int) -> np.ndarray:
-        """(N, block_size) uint8 -> (N,) uint32 per-block crc32c.
-        Host-native by default (the H2D tunnel makes device crc a loss
-        for host-resident buffers; flip ec_offload_crc_device on
-        hardware where the link is wide) — either way the work leaves
-        the event loop and coalesces across callers."""
+    async def crc32c_blocks(self, blocks, block_size: int) -> np.ndarray:
+        """(N, block_size) uint8 — or a LIST of such arrays (a scatter
+        job, e.g. one EC write's per-shard buffers) — -> (N,) uint32
+        per-block crc32c. Scatter fragments stack directly into the
+        warm staging pages at batch build instead of the caller paying
+        an intermediate join. Host-native by default (the H2D tunnel
+        makes device crc a loss for host-resident buffers; flip
+        ec_offload_crc_device on hardware where the link is wide) —
+        either way the work leaves the event loop and coalesces across
+        callers."""
         key = ("crc", bool(self.crc_device), block_size)
         use_device = self.crc_device
 
@@ -682,8 +693,12 @@ class OffloadService:
         def fallback(batch: np.ndarray) -> np.ndarray:
             return _host_crc(batch, block_size)
 
-        return await self._submit(key, np.ascontiguousarray(blocks),
-                                  dispatch, fallback,
+        if isinstance(blocks, (list, tuple)):
+            blocks = [np.ascontiguousarray(b).reshape(-1, block_size)
+                      for b in blocks]
+        else:
+            blocks = np.ascontiguousarray(blocks)
+        return await self._submit(key, blocks, dispatch, fallback,
                                   uses_device=use_device)
 
     async def repair(self, ec_impl, helpers: tuple[int, ...],
@@ -746,7 +761,8 @@ class OffloadService:
                       shard_dispatch: Callable | None = None) -> np.ndarray:
         if not self.enabled:
             return self._inline(data, dispatch, fallback, uses_device)
-        nbytes = int(data.nbytes)
+        nbytes = int(sum(f.nbytes for f in data)) \
+            if isinstance(data, list) else int(data.nbytes)
         await self._acquire(nbytes)
         self.perf.inc("jobs")
         self.stats["jobs"] += 1
@@ -770,12 +786,19 @@ class OffloadService:
             # admission budget is held until the job's batch completed
             self._release(nbytes)
 
-    def _inline(self, data: np.ndarray, dispatch: Callable,
+    def _inline(self, data, dispatch: Callable,
                 fallback: Callable, uses_device: bool) -> np.ndarray:
         """Bypass (ec_offload_enabled=false): the pre-service per-op
         synchronous dispatch, breaker semantics included — this is the
         baseline the bench's inline comparison measures. Dispatches on
         the default device (slot 0), like the pre-mesh service."""
+        if isinstance(data, list):
+            # scatter job on the bypass path: the kernel needs one
+            # contiguous batch, so the fragments pay the join here
+            t0 = time.perf_counter()
+            data = np.concatenate(data, axis=0)
+            copytrack.copied("buffer_to_staging", int(data.nbytes),
+                             time.perf_counter() - t0)
         self.perf.inc("jobs")
         self.stats["jobs"] += 1
         nbytes = int(data.nbytes)
@@ -933,21 +956,33 @@ class OffloadService:
                                  return_exceptions=True)
 
     def _stack(self, slot: _DeviceSlot, jobs: list[_Job]):
-        """Jobs -> one contiguous batch. A lone job's array is handed
-        through by reference (zero-copy: the memoryview-through path
-        from bufferlist to staging); coalesced jobs pay one stacking
-        copy into the slot's REUSED staging array — the
-        bufferlist->staging leg of the copy ledger. Returns
+        """Jobs -> one contiguous batch. A lone single-array job is
+        handed through by reference (zero-copy: the memoryview-through
+        path from bufferlist to staging); everything else — coalesced
+        jobs AND scatter jobs' fragments — stacks in one pass straight
+        into the slot's REUSED staging array (warm pages, no
+        intermediate bufferlist join anywhere on the path; the old
+        b"".join the callers did before submitting showed up as an
+        unmetered extra copy of every csum'd byte). Returns
         (stacked, staging_buf_or_None, stack_seconds)."""
-        if len(jobs) == 1:
+        frags: list[np.ndarray] = []
+        for j in jobs:
+            if isinstance(j.data, list):
+                frags.extend(j.data)
+            else:
+                frags.append(j.data)
+        if len(frags) == 1:
             copytrack.referenced("buffer_to_staging", jobs[0].nbytes)
-            return jobs[0].data, None, 0.0
-        nbytes = sum(j.nbytes for j in jobs)
-        rows = sum(j.rows for j in jobs)
+            return frags[0], None, 0.0
+        nbytes = sum(int(f.nbytes) for f in frags)
+        rows = sum(f.shape[0] for f in frags)
         t0 = time.perf_counter()
         buf = slot.get_staging(nbytes)
-        view = buf[:nbytes].reshape((rows,) + jobs[0].data.shape[1:])
-        np.concatenate([j.data for j in jobs], axis=0, out=view)
+        view = buf[:nbytes].reshape((rows,) + frags[0].shape[1:])
+        row = 0
+        for f in frags:
+            np.copyto(view[row:row + f.shape[0]], f)
+            row += f.shape[0]
         dt = time.perf_counter() - t0
         copytrack.copied("buffer_to_staging", nbytes, dt)
         return view, buf, dt

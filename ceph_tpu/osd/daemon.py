@@ -118,6 +118,14 @@ class OSD(Dispatcher):
         # batcher live via the config observer
         from ceph_tpu import offload
         offload.register_config(self.config)
+        # per-peer message batching knobs (msgr_batch_*): hot-togglable
+        # through the same observer path — `config set
+        # msgr_batch_linger_us 1000` retunes the wire batcher live
+        from ceph_tpu.msg import messenger as msgr_mod
+        msgr_mod.register_config(self.config)
+        # the msgr frame/batch counters must exist before the first
+        # MgrReport so their families export from round one
+        msgr_mod.msgr_perf()
         # runtime asyncio sanitizer (debug mode + slow-callback log +
         # task spawn-site tracking): `config set sanitizer_enabled
         # true` arms the running loop live
@@ -257,7 +265,7 @@ class OSD(Dispatcher):
             device_cb=self._mgr_device_metrics,
             client_cb=self._mgr_client_metrics,
             extra_loggers=("offload", "sanitizer", "loopprof",
-                           "copyflow"))
+                           "copyflow", "msgr"))
         # the per-loop offload service handle (set at start(): the
         # admin-socket thread cannot resolve the running loop itself)
         self._offload_svc = None

@@ -182,8 +182,12 @@ class ECBackend(PGBackend):
                 or (total_blocks < 256 and not svc.crc_device)):
             return {i: self._csums(b) for i, b in shards.items()}
         order = sorted(shards)
+        # ONE scatter CrcJob over the per-shard buffers: the fragments
+        # stack straight into the offload service's warm staging pages
+        # (the old b"".join here paid an unmetered full copy of every
+        # csum'd byte before the job was even submitted)
         crcs = await self._checksummer.calculate_async(
-            b"".join(shards[i] for i in order), service=svc)
+            [shards[i] for i in order], service=svc)
         out: dict[int, list[int]] = {}
         row = 0
         for i in order:

@@ -144,30 +144,42 @@ def _encode_frame(sinfo: StripeInfo, ec_impl, data, want):
 
 def _encode_assemble(stripes: np.ndarray, parity: np.ndarray, k: int,
                      want, sp=None) -> dict[int, memoryview]:
-    """Shard planes -> per-shard reply buffers, ONE copy per byte.
+    """Shard planes -> per-shard reply buffers, AT MOST one copy per
+    byte — and zero for contiguous planes.
 
-    The old path paid two: a full shard-major transpose
-    materialization of every shard, then .tobytes() per wanted shard
-    (bytes is immutable, so any bytes reply costs a second copy and
-    the unwanted shards were materialized for nothing). Here each
-    WANTED plane is written straight into a bytearray through a numpy
-    view and handed downstream as a memoryview — message frames,
-    object-store writes and crc all take buffer objects, so no further
-    copy happens until the wire."""
+    A shard's chunks-per-stripe plane `stripes[:, i, :]` (or
+    `parity[:, i-k, :]`) is C-contiguous whenever the write is a single
+    stripe (S == 1, every one-stripe client op) or the axis being
+    indexed has size 1 (m == 1 parity) — in that case the plane IS the
+    reply buffer and a memoryview over it goes downstream as-is
+    (message frames, object-store writes and crc all take buffer
+    objects), metered referenced. Strided planes (multi-stripe, k or
+    m >= 2) still pay the single extraction copy into a fresh
+    bytearray — the remaining reply_assemble ledger entry."""
     t0 = time.perf_counter()
     S, _, C = stripes.shape
     out: dict[int, memoryview] = {}
-    nbytes = 0
+    copied = 0
+    referenced = 0
     for i in sorted(want):
         src = stripes[:, i, :] if i < k else parity[:, i - k, :]
+        if src.flags.c_contiguous:
+            # no materialization: the plane is a window over the encode
+            # input (data shards) or the device result (parity)
+            out[i] = memoryview(src.reshape(S * C))
+            referenced += S * C
+            continue
         buf = bytearray(S * C)
         np.copyto(np.frombuffer(buf, dtype=np.uint8).reshape(S, C), src)
         out[i] = memoryview(buf)
-        nbytes += S * C
+        copied += S * C
     dt = time.perf_counter() - t0
-    copytrack.copied("reply_assemble", nbytes, dt)
+    if referenced:
+        copytrack.referenced("reply_assemble", referenced)
+    if copied:
+        copytrack.copied("reply_assemble", copied, dt)
     if sp is not None:
-        sp.set_tag("copy_bytes", nbytes)
+        sp.set_tag("copy_bytes", copied)
         sp.set_tag("copy_us", round(dt * 1e6, 1))
     return out
 

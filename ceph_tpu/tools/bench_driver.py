@@ -90,7 +90,125 @@ def stage_cpu() -> dict:
     except Exception as e:
         log(f"cpu_crc32c: FAILED {type(e).__name__}: {e}")
         results["cpu_crc32c"] = 0.0
+    results.update(_msgr_frame_microbench())
     return results
+
+
+def _msgr_frame_microbench() -> dict:
+    """Messenger frame-codec microbench: whole-frame encode+decode
+    round trips per second, native C codec vs the pure-Python fallback,
+    over a data-plane-shaped frame (two small JSON segments + one 32
+    KiB data segment — the k=8 sub-op shape). The per-frame Python this
+    PR removes is exactly the delta between these two rates."""
+    out: dict = {}
+    try:
+        from ceph_tpu.msg import frames
+        from ceph_tpu.msg.frames import Frame, Tag
+        seg = bytes(range(256)) * 128          # 32 KiB
+        frame = Frame(Tag.MESSAGE,
+                      [b'{"type":112,"seq":123}', b'{"sub":"x"}' * 8,
+                       seg])
+        was = frames.native_active()
+        try:
+            for label, use_native in (("native", True), ("python", False)):
+                if use_native and not frames.set_native(True):
+                    out["msgr_frames_per_s_native"] = 0.0
+                    continue
+                frames.set_native(use_native)
+                blob = frame.encode()
+                n = 4000
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    frame.encode_parts()
+                    Frame.decode(blob)
+                rate = n / (time.perf_counter() - t0)
+                out[f"msgr_frames_per_s_{label}"] = round(rate, 1)
+        finally:
+            frames.set_native(was)
+        if out.get("msgr_frames_per_s_python"):
+            out["msgr_frame_native_speedup"] = round(
+                (out.get("msgr_frames_per_s_native") or 0.0)
+                / out["msgr_frames_per_s_python"], 3)
+        log(f"msgr_frames: native {out.get('msgr_frames_per_s_native')}"
+            f"/s python {out.get('msgr_frames_per_s_python')}/s "
+            f"(x{out.get('msgr_frame_native_speedup')})")
+    except Exception as e:
+        log(f"msgr_frames: FAILED {type(e).__name__}: {e}")
+    try:
+        out.update(_msgr_saturated_batching())
+    except Exception as e:
+        log(f"msgr_saturated: FAILED {type(e).__name__}: {e}")
+    return out
+
+
+def _msgr_saturated_batching() -> dict:
+    """Per-peer batching at connection saturation: a real messenger
+    pair over localhost, the sender enqueuing one client EC write's
+    worth of data-plane traffic (k=8,m=3: 11 sub-op-sized messages one
+    way — the other 11 of the 22 are the mirror direction) faster than
+    the wire drains. Reports frames per 11-message write-equivalent —
+    the asymptote the in-situ number approaches as per-connection
+    queue depth grows (today capped by the per-PG op pipeline)."""
+    import asyncio
+
+    from ceph_tpu.msg import messages as M
+    from ceph_tpu.msg import messenger as msgr_mod
+    from ceph_tpu.msg.messenger import (Dispatcher, Messenger, Policy,
+                                        msgr_perf)
+
+    WRITES, PER_WRITE = 200, 11
+
+    async def body() -> dict:
+        got = [0]
+        done = asyncio.Event()
+
+        class Sink(Dispatcher):
+            async def ms_dispatch(self, conn, msg):
+                if isinstance(msg, M.MOSDECSubOpWrite):
+                    got[0] += 1
+                    if got[0] >= WRITES * PER_WRITE:
+                        done.set()
+                    return True
+                return False
+
+        srv = Messenger("bench-msgr-srv")
+        srv.add_dispatcher(Sink())
+        addr = await srv.bind("127.0.0.1", 0)
+        cli = Messenger("bench-msgr-cli")
+        conn = await cli.connect(addr, Policy.lossless_peer())
+        pc = msgr_perf()
+        base = dict(pc.dump())
+        payload = bytes(4096)
+        t0 = time.perf_counter()
+        for w in range(WRITES):
+            for s in range(PER_WRITE):
+                conn.send_message(M.MOSDECSubOpWrite(
+                    {"tid": w, "shard": s}, payload))
+            if w % 8 == 0:
+                await asyncio.sleep(0)      # let the write loop drain
+        await asyncio.wait_for(done.wait(), 30)
+        dt = time.perf_counter() - t0
+        d = {k: v - base[k] for k, v in pc.dump().items()
+             if isinstance(v, int) and k in base}
+        await cli.shutdown()
+        await srv.shutdown()
+        frames_per_write = d["data_frames_tx"] / WRITES
+        return {
+            "msgr_saturated_frames_per_write": round(frames_per_write, 2),
+            "msgr_saturated_msgs_per_s": round(
+                WRITES * PER_WRITE / dt, 1),
+        }
+
+    enabled = msgr_mod._BATCH_DEFAULTS["enabled"]
+    try:
+        msgr_mod._BATCH_DEFAULTS["enabled"] = True
+        out = asyncio.run(body())
+    finally:
+        msgr_mod._BATCH_DEFAULTS["enabled"] = enabled
+    log(f"msgr_saturated: {out['msgr_saturated_frames_per_write']} "
+        f"frames per 11-msg write-equivalent at "
+        f"{out['msgr_saturated_msgs_per_s']} msgs/s")
+    return out
 
 
 def stage_probe() -> dict:
@@ -513,6 +631,46 @@ def stage_cluster_tpu() -> dict:
                 results["offload_coalesced_ops"] = do["coalesced_ops"]
                 results["offload_fallback_ops"] = do["fallback_ops"]
                 results["offload_status"] = osds[0]._offload_admin("status")
+
+                # frames per client EC write (k=8,m=3), from the msgr
+                # perf counters: many PGs + deep client concurrency so
+                # per-OSD fan-outs overlap and coalesce per peer conn —
+                # pre-batching this was 22 frames/write (1 op + 10
+                # sub-ops + 10 replies + 1 reply). data_frames counts
+                # only the data plane, so heartbeats/mgr reports don't
+                # pollute the figure. (The per-PG op pipeline serializes
+                # each PG's writes, which caps per-connection queue
+                # depth — the saturated-connection asymptote lives in
+                # the cpu stage's msgr microbench; ROADMAP names PG op
+                # pipelining as the next lever.)
+                from ceph_tpu.msg.messenger import msgr_perf
+                await client.pool_create("msgrbench", pg_num=32,
+                                         pool_type="erasure",
+                                         erasure_code_profile="tpuprof")
+                iom = client.ioctx("msgrbench")
+                await asyncio.gather(*[iom.write_full(f"w{i}", payload)
+                                       for i in range(8)])
+                pc = msgr_perf()
+                base_m = dict(pc.dump())
+                counts2: dict = {}
+                wm = await _phase(iom, "write", 128, 2.0, OBJ, counts2)
+                dm = {k: v - base_m[k] for k, v in pc.dump().items()
+                      if isinstance(v, int) and k in base_m}
+                ops = max(1, wm["ops"])
+                results["msgr_frames_per_ec_write"] = round(
+                    dm.get("data_frames_tx", 0) / ops, 2)
+                results["msgr_batches"] = dm.get("batches_tx", 0)
+                results["msgr_batched_msgs"] = dm.get("batched_msgs", 0)
+                results["msgr_batch_write_mb_s"] = wm["mb_per_s"]
+                results["msgr_mean_batch_msgs"] = round(
+                    dm.get("batched_msgs", 0)
+                    / dm.get("batches_tx", 1), 2) \
+                    if dm.get("batches_tx") else 0.0
+                log(f"msgr_batch: {results['msgr_frames_per_ec_write']} "
+                    f"data frames/write over {ops} deep-queue writes "
+                    f"({results['msgr_batch_write_mb_s']} MB/s, "
+                    f"mean batch {results['msgr_mean_batch_msgs']} "
+                    f"msgs)")
             finally:
                 offload.set_enabled(True)
 
@@ -1277,11 +1435,21 @@ def stage_attribution() -> dict:
                     (max(active) - min(active)) / max(active), 4) \
                     if len(active) >= 2 else 0.0
                 results["device_busy_skew"] = att["device_busy_skew"]
+                bk = att["buckets_us"]
+                # Python-per-op: what's left of op_total after the
+                # device legs (h2d/kernel/d2h), the metered copies, and
+                # the store commit — the messaging/dispatch/scheduling
+                # Python this PR's batching + native frame path exists
+                # to shrink (trend-guarded as a COST: a rise is a
+                # regression even when MB/s holds)
+                att["python_us_per_op"] = round(max(0.0, (
+                    att["op_total_us"] - bk["h2d"] - bk["kernel"]
+                    - bk["d2h"] - bk["copy"] - bk["commit"])), 1)
+                results["python_us_per_op"] = att["python_us_per_op"]
                 results["attribution"] = att
                 results["copy_amplification"] = att["copy_amplification"]
                 results["loop_busy_fraction"] = att["loop_busy_fraction"]
                 results["attribution_write_mb_s"] = w["mb_per_s"]
-                bk = att["buckets_us"]
                 log(f"attribution: op_total {att['op_total_us']}us over "
                     f"{att['ops']} ops | " + " ".join(
                         f"{b}={bk[b]}" for b in ATTRIBUTION_BUCKETS)
@@ -1322,7 +1490,8 @@ TREND_KEYS_COST = ("copy_amplification", "loop_busy_fraction",
                    "failure_storm_time_to_clean_s",
                    "failure_storm_repair_ratio",
                    "device_busy_skew", "shard_busy_skew",
-                   "swarm_p99_fairness")
+                   "swarm_p99_fairness", "python_us_per_op",
+                   "msgr_frames_per_ec_write")
 TREND_THRESHOLD_PCT = 10.0
 
 

@@ -81,18 +81,9 @@ class Checksummer:
             csums = csums & ((1 << bits) - 1)
         return csums
 
-    async def calculate_async(self, data, service=None) -> np.ndarray:
-        """calculate() with the per-block crc batch submitted through
-        the process-wide offload service: the blocks coalesce with
-        concurrent callers (EC shard csums, other checksummers) into one
-        CrcJob and the work leaves the event loop. Falls back to the
-        inline path without a service, for non-batchable buffers, or
-        when the type is none."""
-        import jax
-
-        if service is None or self.csum_type == CSUM_NONE \
-                or isinstance(data, jax.Array):
-            return self.calculate(data)
+    def _as_blocks(self, data) -> np.ndarray:
+        """One buffer -> an (N, block_size) uint8 view (no copy for
+        bytes-likes and contiguous arrays)."""
         if isinstance(data, (bytes, bytearray, memoryview)):
             arr = np.frombuffer(data, dtype=np.uint8)
         else:
@@ -101,9 +92,37 @@ class Checksummer:
             raise ValueError(
                 f"buffer size {arr.size} not a multiple of csum block "
                 f"{self.block_size}")
-        if arr.size == 0:
-            return np.zeros(0, dtype=np.uint32)
-        blocks = arr.reshape(-1, self.block_size)
+        return arr.reshape(-1, self.block_size)
+
+    async def calculate_async(self, data, service=None) -> np.ndarray:
+        """calculate() with the per-block crc batch submitted through
+        the process-wide offload service: the blocks coalesce with
+        concurrent callers (EC shard csums, other checksummers) into one
+        CrcJob and the work leaves the event loop. `data` may be a LIST
+        of block-aligned buffers (an EC write's shard buffers): they
+        ride ONE scatter CrcJob whose fragments stack directly into the
+        service's warm staging pages — no b"".join on the submit path —
+        and the result concatenates in fragment order. Falls back to
+        the inline path without a service, for non-batchable buffers,
+        or when the type is none."""
+        import jax
+
+        scattered = isinstance(data, (list, tuple))
+        if service is None or self.csum_type == CSUM_NONE \
+                or (not scattered and isinstance(data, jax.Array)):
+            if not scattered:
+                return self.calculate(data)
+            parts = [self.calculate(d) for d in data]
+            return np.concatenate(parts) if parts \
+                else np.zeros(0, dtype=np.uint32)
+        if scattered:
+            blocks = [self._as_blocks(d) for d in data if len(d)]
+            if not blocks:
+                return np.zeros(0, dtype=np.uint32)
+        else:
+            blocks = self._as_blocks(data)
+            if blocks.size == 0:
+                return np.zeros(0, dtype=np.uint32)
         csums = np.asarray(await service.crc32c_blocks(blocks,
                                                        self.block_size))
         bits = _VALUE_BITS[self.csum_type]
