@@ -414,8 +414,13 @@ class _DeviceSlot:
     def put_staging(self, buf: np.ndarray) -> None:
         self.staging.append(buf)
         while len(self.staging) > self.depth:
-            # keep the largest buffers (they satisfy every batch size)
-            self.staging.remove(min(self.staging, key=lambda a: a.nbytes))
+            # keep the largest buffers (they satisfy every batch size).
+            # Evict by INDEX: list.remove(array) compares elementwise
+            # and raises on mixed shapes — pipelined PGs return
+            # different-sized staging pages concurrently
+            smallest = min(range(len(self.staging)),
+                           key=lambda i: self.staging[i].nbytes)
+            del self.staging[smallest]
 
 
 class OffloadService:

@@ -16,6 +16,7 @@ sub-op acks resolve asyncio futures instead of Context callbacks.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 from typing import TYPE_CHECKING
 
@@ -47,6 +48,31 @@ class PGBackend:
         self._tid = 0
         # tid -> (pending peer set, future)
         self._inflight: dict[int, tuple[set[int], asyncio.Future]] = {}
+        # per-object write ordering (the reference's ObjectContext rw
+        # locks): pipelined PG execution runs ops to DIFFERENT objects
+        # concurrently; the commit section of same-object mutations —
+        # log intent + apply/fan-out — must serialize or interleave
+        # into lost updates. oid -> [lock, users]; refcounted so churn
+        # workloads don't grow the dict unboundedly.
+        self._obj_locks: dict[str, list] = {}
+
+    @contextlib.asynccontextmanager
+    async def obj_lock(self, oid: str):
+        """Acquire this object's write-ordering lock (FIFO-fair:
+        asyncio.Lock wakes waiters in acquisition order, so same-object
+        ops commit in arrival order). NOT reentrant — a holder must not
+        re-enter the modify path for the same oid."""
+        ent = self._obj_locks.get(oid)
+        if ent is None:
+            ent = self._obj_locks[oid] = [asyncio.Lock(), 0]
+        ent[1] += 1
+        try:
+            async with ent[0]:
+                yield
+        finally:
+            ent[1] -= 1
+            if ent[1] == 0 and self._obj_locks.get(oid) is ent:
+                del self._obj_locks[oid]
 
     # -- identity ------------------------------------------------------------
 
@@ -408,15 +434,19 @@ class ReplicatedBackend(PGBackend):
         p = msg.payload
         entry = LogEntry.from_dict(p["entry"])
         self.local_apply(p["oid"], p["op"], msg.data, off=p.get("off", 0))
-        if entry.version > self.pg.log.head:
-            self.pg.log.append(entry)
+        # insert, not append: a pipelined primary's concurrent fan-outs
+        # can deliver v6 before v5 — the old `> head` guard dropped the
+        # late entry, leaving this replica's log (and dup index) with a
+        # hole a failover would promote
+        self.pg.log.insert(entry)
         if p["op"] in ("push", "delete", "create"):
             # only FULL-state ops supersede a missing base; an extent
             # write — and now write_full too, since it preserves
             # xattrs/omap it cannot supply — leaves a missing object
             # missing until recovery pushes the whole state
             self.pg.log.mark_recovered(p["oid"])
-        self.pg.persist_meta()
-        conn.send_message(MOSDRepOpReply(
+        # coalesced with any other sub-ops landing this loop slice; the
+        # ack rides the flush so rc=0 never outruns the durable entry
+        self.pg.persist_meta_soon(ack=(conn, MOSDRepOpReply(
             {"pgid": p["pgid"], "tid": p["tid"],
-             "from": self.host.whoami, "rc": 0}))
+             "from": self.host.whoami, "rc": 0})))

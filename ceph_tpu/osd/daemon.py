@@ -39,7 +39,8 @@ from ceph_tpu.utils.admin_socket import AdminSocket
 from ceph_tpu.utils.async_util import reap_all
 from ceph_tpu.utils.config import Config, Option
 from ceph_tpu.utils.dout import dout
-from ceph_tpu.utils.perf_counters import (TYPE_AVG, TYPE_HISTOGRAM,
+from ceph_tpu.utils.perf_counters import (TYPE_AVG, TYPE_GAUGE,
+                                          TYPE_HISTOGRAM,
                                           PerfCountersCollection)
 from ceph_tpu.utils.throttle import AdjustableSemaphore, HeartbeatMap
 from ceph_tpu.utils.work_queue import (ClientTable, Finisher, OpTracker,
@@ -60,6 +61,8 @@ class OSD(Dispatcher):
     DEEP_SCRUB_EVERY = 4        # every Nth scrub round goes deep
 
     MAX_RECOVERY_IN_FLIGHT = 4  # osd_max_backfills / AsyncReserver slots
+
+    PG_PIPELINE_DEPTH = 4       # per-PG execution window (1 = serial)
 
     def __init__(self, whoami: int, mon_addrs: list[tuple[str, int]],
                  store=None, crush_location: dict | None = None,
@@ -91,6 +94,13 @@ class OSD(Dispatcher):
                    "host-wide recovery reservation slots (hot: resizes "
                    "the live pool, so recovery pressure can be tuned "
                    "mid-storm)", minimum=1),
+            Option("osd_pg_pipeline_depth", "int",
+                   self.PG_PIPELINE_DEPTH,
+                   "max concurrent client ops in the execution slice "
+                   "per PG (distinct objects only; the pg-log ordered "
+                   "slice stays strictly FIFO). 1 = the legacy serial "
+                   "pipeline, bit-identical. Hot: resizes the live "
+                   "admission window", minimum=1),
             Option("osd_ec_repair_subchunks", "bool", True,
                    "use regenerating-code sub-chunk repair plans for "
                    "single-shard recovery (fetch repair fragments from "
@@ -167,6 +177,15 @@ class OSD(Dispatcher):
                       description="peers reported failed to the mon")
         # per-stage latency histograms (power-of-two µs buckets; the
         # exporter renders them as cumulative prometheus histograms)
+        # per-PG pipelined execution (the PrimaryLogPG concurrency
+        # window): live occupancy + admissions parked on a full window
+        self.perf.add("pg_pipeline_inflight", type=TYPE_GAUGE,
+                      description="ops currently in pipelined "
+                                  "execution across this OSD's PGs")
+        self.perf.add("pg_pipeline_window_stalls",
+                      description="shard-worker waits with queued work "
+                                  "blocked behind a full per-PG "
+                                  "pipeline window")
         self.perf.add("op_total_us", type=TYPE_HISTOGRAM,
                       description="client op total latency (µs)")
         self.perf.add("op_queue_wait_us", type=TYPE_HISTOGRAM,
@@ -198,7 +217,11 @@ class OSD(Dispatcher):
         self.op_queue = ShardedOpQueue(
             f"osd.{whoami}.op_tp",
             num_shards=self.config.get("osd_op_num_shards"),
-            hb_map=self.hb_map)
+            hb_map=self.hb_map,
+            pipeline_depth=self.config.get("osd_pg_pipeline_depth"),
+            perf=self.perf)
+        self.config.add_observer(("osd_pg_pipeline_depth",),
+                                 self._on_pipeline_depth)
         self.finisher = Finisher(f"osd.{whoami}.finisher",
                                  hb_map=self.hb_map)
         self.asok: AdminSocket | None = None
@@ -376,7 +399,11 @@ class OSD(Dispatcher):
                 "num_pgs": len(self.pgs),
                 "hb_healthy": self.hb_map.is_healthy()[0],
                 "reactor_shard": self.shard,
-                "ops_processed": self.op_queue.processed}
+                "ops_processed": self.op_queue.processed,
+                "pipeline": {
+                    "depth": self.op_queue.pipeline_depth,
+                    "in_flight": self.op_queue.total_in_flight(),
+                    "window_stalls": self.op_queue.window_stalls}}
 
     def _mgr_health_metrics(self) -> dict:
         """Daemon health metrics for the report path: slow ops from the
@@ -445,22 +472,32 @@ class OSD(Dispatcher):
 
     # -- fault injection (admin `inject` + injector-driven hooks) ------------
 
-    def _on_recovery_slots(self, name: str, value) -> None:
-        """osd_max_recovery_in_flight observer: resize the live slot
-        pool. Config sets arrive from admin-socket threads; the
-        semaphore is loop-bound, so hop onto the loop when off it."""
+    def _run_on_loop(self, fn, *args) -> None:
+        """Run `fn(*args)` on this daemon's loop: config observers fire
+        from admin-socket threads, and the targets (wake events,
+        semaphores) are loop-bound — hop via call_soon_threadsafe when
+        off the loop, run inline when already on it (or when the
+        daemon's loop is gone)."""
         loop = self._loop
-        on_loop = False
         if loop is not None and not loop.is_closed():
             try:
                 on_loop = asyncio.get_running_loop() is loop
             except RuntimeError:
                 on_loop = False
             if not on_loop:
-                loop.call_soon_threadsafe(
-                    self.recovery_reservations.resize, int(value))
+                loop.call_soon_threadsafe(fn, *args)
                 return
-        self.recovery_reservations.resize(int(value))
+        fn(*args)
+
+    def _on_pipeline_depth(self, name: str, value) -> None:
+        """osd_pg_pipeline_depth observer: hot-resize the live per-PG
+        admission window."""
+        self._run_on_loop(self.op_queue.set_pipeline_depth, int(value))
+
+    def _on_recovery_slots(self, name: str, value) -> None:
+        """osd_max_recovery_in_flight observer: resize the live slot
+        pool."""
+        self._run_on_loop(self.recovery_reservations.resize, int(value))
 
     def _inject_admin(self, req: dict) -> dict:
         """`inject` admin-socket verbs — the same injector the config
@@ -668,6 +705,12 @@ class OSD(Dispatcher):
             await self.mgr_client.stop()
             await self.monc.close()
             await self.messenger.shutdown()
+            # coalesced persist flush LAST, after the messenger is down:
+            # a sub-op dispatched mid-teardown re-arms the call_soon
+            # flush, and an earlier flush would leave that dirty delta
+            # to fire after umount (applied data without its log entry)
+            for pg in self.pgs.values():
+                pg.flush_persist()
             self.store.umount()
         finally:
             self._stop_event.set()
@@ -1071,6 +1114,19 @@ class OSD(Dispatcher):
             self.perf.avg_add("op_latency", lat)
             self.perf.hist_add("op_total_us", lat * 1e6)
 
+    @staticmethod
+    def _op_object(msg: MOSDOp) -> str | None:
+        """The object stream a client op belongs to, for the pipelined
+        window's per-object FIFO. None (an exclusive whole-PG barrier)
+        when the op vector names no single object — multi-object
+        messages and listings keep the legacy serial semantics."""
+        oids = {o.get("oid") for o in msg.payload.get("ops", [])}
+        if len(oids) == 1:
+            oid = oids.pop()
+            if oid is not None:
+                return oid
+        return None
+
     def _enqueue_op(self, pgid: PG, seq: int, conn: Connection,
                     msg: MOSDOp, trk) -> None:
         t_enq = time.monotonic()
@@ -1088,7 +1144,8 @@ class OSD(Dispatcher):
             self.perf.hist_add("op_queue_wait_us", wait_us)
             await self._execute_op(conn, msg, trk,
                                    queue_wait_us=round(wait_us, 1))
-        self.op_queue.enqueue((pgid.pool, pgid.ps), work)
+        self.op_queue.enqueue((pgid.pool, pgid.ps), work,
+                              obj=self._op_object(msg))
 
     def requeue_waiting(self, pg: PGInstance) -> None:
         """PG activation (or loss of primacy) drains its parked ops in

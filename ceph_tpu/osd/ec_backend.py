@@ -92,11 +92,10 @@ class ECBackend(PGBackend):
         self._zcrc = self._crc32c(b"\x00" * self.sinfo.chunk_size)
         # read gather plumbing: tid -> future resolving to (payload, data)
         self._read_waiters: dict[int, asyncio.Future] = {}
-        # RMW writes read-modify-write whole stripes: concurrent writers
-        # to one object must serialize or interleave into lost updates
-        # (the reference's ObjectContext rw locks). oid -> [lock, users];
-        # refcounted so churn workloads don't grow the dict unboundedly
-        self._obj_locks: dict[str, list] = {}
+        # per-object write ordering lives in PGBackend._obj_locks now
+        # (obj_lock): the PG's modify path holds it across log intent +
+        # this backend's RMW/fan-out, and the replicated backend shares
+        # the same discipline under pipelined execution
         # observability: extent bytes served to sub-reads (tests assert
         # ranged reads move << object size)
         self.sub_read_bytes_served = 0
@@ -251,26 +250,18 @@ class ECBackend(PGBackend):
 
     async def execute_write(self, oid: str, op: str, data: bytes,
                             entry: LogEntry, off: int = 0) -> None:
-        ent = self._obj_locks.get(oid)
-        if ent is None:
-            ent = self._obj_locks[oid] = [asyncio.Lock(), 0]
-        ent[1] += 1
-        try:
-            async with ent[0]:
-                with tracer.span("ec_write",
-                                 f"osd.{self.host.whoami}") as sp:
-                    if sp is not None:
-                        sp.set_tag("op", op)
-                        sp.set_tag("oid", oid)
-                        sp.set_tag("bytes", len(data))
-                        sp.set_tag("k", self.k)
-                        sp.set_tag("m", self.n - self.k)
-                    await self._execute_write_locked(oid, op, data,
-                                                     entry, off)
-        finally:
-            ent[1] -= 1
-            if ent[1] == 0 and self._obj_locks.get(oid) is ent:
-                del self._obj_locks[oid]
+        """Runs under the caller's obj_lock (PG._do_modify holds it
+        across log intent + this call; _rewrite_consistent takes it for
+        the recovery-side rewrite) — pipelined ops to DIFFERENT objects
+        overlap here, same-object RMWs serialize."""
+        with tracer.span("ec_write", f"osd.{self.host.whoami}") as sp:
+            if sp is not None:
+                sp.set_tag("op", op)
+                sp.set_tag("oid", oid)
+                sp.set_tag("bytes", len(data))
+                sp.set_tag("k", self.k)
+                sp.set_tag("m", self.n - self.k)
+            await self._execute_write_locked(oid, op, data, entry, off)
 
     async def _execute_write_locked(self, oid: str, op: str, data: bytes,
                                     entry: LogEntry, off: int) -> None:
@@ -569,6 +560,7 @@ class ECBackend(PGBackend):
         peers = {o for o in live.values() if o != self.host.whoami}
         fut = self._start_waiting(tid, peers)
         failed = []
+        entry_dict = entry.to_dict()    # once, not per peer
         for idx, osd in live.items():
             sub, chunk = payloads[idx]
             if osd == self.host.whoami:
@@ -579,7 +571,7 @@ class ECBackend(PGBackend):
                     {"pgid": [self.pg.pgid.pool, self.pg.pgid.ps],
                      "tid": tid, "from": self.host.whoami, "oid": oid,
                      "shard": idx, "sub": sub,
-                     "entry": entry.to_dict()}, chunk))
+                     "entry": entry_dict}, chunk))
             except Exception as e:
                 # an unreachable peer the map hasn't caught up on: the
                 # write must NOT be acked with a subset of live shards —
@@ -995,17 +987,23 @@ class ECBackend(PGBackend):
         if isinstance(msg, MOSDECSubOpWrite):
             self._apply_sub_write(p["oid"], p["shard"], p["sub"], msg.data)
             entry = LogEntry.from_dict(p["entry"])
-            if entry.version > self.pg.log.head:
-                self.pg.log.append(entry)
+            # out-of-order-tolerant insert: pipelined same-PG fan-outs
+            # to different objects can arrive v6-before-v5 (see
+            # ReplicatedBackend.handle_rep_op)
+            self.pg.log.insert(entry)
             if p["sub"]["op"] in ("write_full", "delete"):
                 # full-state sub-ops supersede whatever was missing;
                 # an EXTENT write does not restore the base, so a
                 # recovering shard stays in the missing set
                 self.pg.log.mark_recovered(p["oid"])
-            self.pg.persist_meta()
-            conn.send_message(MOSDECSubOpWriteReply(
+            # coalesced: one meta persist per batch drain, not per
+            # sub-op (pipelined primaries ship ~depth entries per
+            # envelope; the apply above is already durable store
+            # state). The reply rides the flush: the ack never outruns
+            # the durable log entry
+            self.pg.persist_meta_soon(ack=(conn, MOSDECSubOpWriteReply(
                 {"pgid": p["pgid"], "tid": p["tid"],
-                 "from": self.host.whoami}))
+                 "from": self.host.whoami})))
             return
         # sub-read: serve our chunk extent, crc-verified per chunk
         # (ECBackend.cc:1015 handle_sub_read, crc verify :1092)
@@ -1071,14 +1069,21 @@ class ECBackend(PGBackend):
         data = (await ec_util.decode_concat_async(
             self.sinfo, self.ec_impl, got,
             service=self._offload_svc()))[:ec_size]
-        version = self.pg.next_version()
-        entry = LogEntry(version=version, op="modify", oid=oid,
-                         prior_version=self.pg._prior(oid))
-        # log-intent-first, like every write (allocation + append in
-        # one slice keeps the log monotonic)
-        self.pg.log.append(entry)
-        self.pg.persist_meta()
-        await self.execute_write(oid, "write_full", data, entry)
+        # recovery-side writer: execute_write no longer locks itself,
+        # so take the object's ordering lock here — a pipelined client
+        # write to the same oid must not interleave with the rewrite
+        async with self.obj_lock(oid):
+            version = self.pg.next_version()
+            entry = LogEntry(version=version, op="modify", oid=oid,
+                             prior_version=self.pg._prior(oid))
+            # log-intent-first, like every write (allocation + append
+            # in one slice keeps the log monotonic)
+            self.pg.log.append(entry, complete=False)
+            self.pg.persist_meta()
+            try:
+                await self.execute_write(oid, "write_full", data, entry)
+            finally:
+                self.pg.log.mark_complete(version)
 
     def _slice_runs(self, data: bytes,
                     runs: list) -> bytes | None:

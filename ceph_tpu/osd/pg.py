@@ -94,6 +94,13 @@ class PGInstance:
         self._active_writes = 0
         self._writes_drained = asyncio.Event()
         self._writes_drained.set()
+        # replica-side meta-persist coalescing: batched sub-op drains
+        # deliver many entries in one loop slice — persist once per
+        # slice, not once per sub-op (see persist_meta_soon). The flag
+        # only dedupes the scheduled callback; acks ride the flush so
+        # no sub-op is acknowledged before its entry is durable.
+        self._persist_scheduled = False
+        self._persist_acks: list[tuple] = []
         # snaps this primary has finished trimming (persisted in meta)
         self.purged_snaps: set[int] = set()
         self._snaptrim_task: asyncio.Task | None = None
@@ -130,6 +137,7 @@ class PGInstance:
 
     def info(self) -> dict:
         return {"last_update": list(self.log.head),
+                "last_complete": list(self.log.last_complete),
                 "log_tail": list(self.log.tail),
                 "last_epoch_started": self.last_epoch_started}
 
@@ -194,6 +202,55 @@ class PGInstance:
             # entries vanish from the persisted omap forever
             self.log.restore_dirty(full, dirty)
             raise
+
+    def persist_meta_soon(self, ack: tuple | None = None) -> None:
+        """Coalesced replica-side persist: a pipelined primary's batch
+        envelopes deliver many sub-ops per loop slice, and each used to
+        re-serialize + write the meta blob individually. One call_soon
+        flush per slice persists them all (the in-memory log is updated
+        synchronously; only the disk write coalesces — the same
+        window a journaling store batches into one commit). The PRIMARY
+        path keeps its synchronous persist: the dup-replay invariant
+        needs the intent durable within the ordered slice.
+
+        `ack` is a deferred (conn, reply) pair sent only AFTER the
+        persist succeeds: a sub-op is never acknowledged while its log
+        entry is not durable — a persist failure drops the acks, the
+        primary's sub-op wait times out, and the client resends
+        (exactly the pre-coalescing failure behavior). Flushed
+        explicitly by flush_persist() at daemon stop."""
+        if ack is not None:
+            self._persist_acks.append(ack)
+        if self._persist_scheduled:
+            return
+        self._persist_scheduled = True
+        asyncio.get_running_loop().call_soon(self._persist_flush)
+
+    def _persist_flush(self) -> None:
+        self._persist_scheduled = False
+        acks, self._persist_acks = self._persist_acks, []
+        try:
+            self.persist_meta()
+        except Exception as e:
+            # the delta was handed back by persist_meta's failure path;
+            # the UNSENT acks make the primary time the sub-ops out, so
+            # nothing is counted replicated that is not persisted
+            dout("osd", 1, f"pg {self.pgid} coalesced meta persist "
+                           f"failed: {type(e).__name__} {e} (delta "
+                           f"restored; sub-op acks withheld)")
+            return
+        for conn, reply in acks:
+            try:
+                conn.send_message(reply)
+            except Exception:
+                pass            # dead peer conn: its timeout handles it
+
+    def flush_persist(self) -> None:
+        """Synchronously flush the coalesced persist (daemon stop:
+        nothing may stay dirty past umount; unconditional — a
+        previously failed flush left dirty state behind with no
+        callback armed)."""
+        self._persist_flush()
 
     def _load_meta(self) -> None:
         cid = self.backend.coll()
@@ -327,6 +384,22 @@ class PGInstance:
             backoff = min(backoff * 2, 2.0)
 
     async def _peer_inner(self) -> None:
+        # drain the pipelined execution window first: ops admitted in
+        # the previous interval must settle (fail_inflight already
+        # errored their sub-op futures, so this is fast) before peers
+        # are queried — no op's fan-out may straddle two intervals, and
+        # the authoritative log election must not race in-flight
+        # appends. Bounded: a write wedged on a dead peer exits via its
+        # own sub-op timeout, not ours.
+        if self._active_writes:
+            self._writes_drained.clear()
+            try:
+                await asyncio.wait_for(self._writes_drained.wait(), 2.0)
+            except asyncio.TimeoutError:
+                dout("osd", 2, f"pg {self.pgid}: {self._active_writes} "
+                               f"pipelined writes still in flight at "
+                               f"peering; proceeding (they fail out to "
+                               f"resend)")
         pgid_key = [self.pgid.pool, self.pgid.ps]
         epoch = self.host.osdmap.epoch
         # GetInfo+GetLog: ask every acting peer for info + log in one round
@@ -494,8 +567,13 @@ class PGInstance:
                         self.host.recovery_reservations.release()
                         if not done.done():
                             done.set_result(None)
+                # obj=oid: the recovery item admits through the PG's
+                # pipelined window alongside client ops to OTHER
+                # objects, but serializes FIFO against any client op
+                # touching the object being rebuilt
                 self.host.op_queue.enqueue(
-                    (self.pgid.pool, self.pgid.ps), work, klass="recovery")
+                    (self.pgid.pool, self.pgid.ps), work,
+                    klass="recovery", obj=oid)
                 await done
                 if oid in self._pending_recovery:
                     # push failed and was re-queued: back off instead of
@@ -1216,15 +1294,19 @@ class PGInstance:
                 return 0, {"version": list(done_ver), "dup": True}, b""
         deadline = asyncio.get_running_loop().time() + 30.0
         while True:
+            if self._write_gate.is_set():
+                # fast path first: the open-gate case (every write
+                # outside a scrub drain) pays NO await — wait_for spun
+                # up a task + timer per modify (profiled on the
+                # pipelined hot path). The is_set check + increment run
+                # in one resume slice (no await between), so
+                # block_writes cannot observe a zero counter while this
+                # write proceeds (TOCTOU)
+                self._active_writes += 1
+                break
             await asyncio.wait_for(
                 self._write_gate.wait(),
                 max(0.1, deadline - asyncio.get_running_loop().time()))
-            if self._write_gate.is_set():
-                # the is_set re-check + increment run in one resume slice
-                # (no await between), so block_writes cannot observe a
-                # zero counter while this write proceeds (TOCTOU)
-                self._active_writes += 1
-                break
         try:
             return await self._do_modify_inner(kind, oid, op, data)
         finally:
@@ -1289,24 +1371,46 @@ class PGInstance:
             data = json.dumps(op["kv"]).encode()
         elif kind == "omap_rm":
             data = json.dumps(op["keys"]).encode()
+        # the commit section: the object's write-ordering lock (FIFO —
+        # same-object ops commit in arrival order; pipelined ops to
+        # OTHER objects proceed concurrently) held across the ordered
+        # slice AND the execution slice, so log intent and local apply
+        # can never interleave with another writer of this object
+        async with self.backend.obj_lock(oid):
+            version, entry = self._log_intent(kind, oid, op)
+            try:
+                await self.backend.execute_write(oid, kind, data, entry,
+                                                 off=op.get("off", 0))
+            finally:
+                # completions land in ANY order under pipelining (a
+                # failed execution settles too — peering owns its
+                # entry's fate); last_complete advances contiguously
+                self.log.mark_complete(version)
+        return 0, {"version": list(version)}, b""
+
+    def _log_intent(self, kind: str, oid: str,
+                    op: dict) -> tuple[Eversion, LogEntry]:
+        """The ordered synchronous slice of a modify: version
+        allocation, log-intent append, dup-index stamp, and the durable
+        meta persist run in ONE event-loop slice (no await), so
+        concurrent pipelined ops can never interleave inside it —
+        appends stay strictly monotonic per PG and a retry of an op
+        that failed anywhere past this point hits the dup index instead
+        of re-executing against partially-applied state. The EC backend
+        verifies a dup hit is actually readable before answering it
+        (see verify_dup_committed) since its entry can be logged while
+        no shard applied. The entry starts INCOMPLETE: the pipelined
+        execution slice settles it via log.mark_complete, in any
+        order."""
         version = self.next_version()
         entry = LogEntry(version=version,
                          op="delete" if kind == "delete" else "modify",
                          oid=oid, prior_version=self._prior(oid),
                          reqid=tuple(op["reqid"]) if op.get("reqid")
                          else None)
-        # LOG INTENT FIRST, atomically with version allocation (no
-        # await in between, so appends stay monotonic): a retry of an
-        # op that failed anywhere past this point hits the dup index
-        # instead of re-executing against partially-applied state. The
-        # EC backend verifies a dup hit is actually readable before
-        # answering it (see verify_dup_committed) since its entry can
-        # be logged while no shard applied.
-        self.log.append(entry)
+        self.log.append(entry, complete=False)
         self.persist_meta()
-        await self.backend.execute_write(oid, kind, data, entry,
-                                         off=op.get("off", 0))
-        return 0, {"version": list(version)}, b""
+        return version, entry
 
     async def _make_writeable(self, oid: str, snapc: dict,
                               reqid) -> None:
@@ -1323,15 +1427,20 @@ class PGInstance:
         head_exists = await self.backend.object_exists(oid)
         payload = json.dumps({"cloneid": max(new), "snaps": sorted(new),
                               "seq_only": not head_exists}).encode()
-        entry = LogEntry(version=self.next_version(), op="modify", oid=oid,
-                         prior_version=self._prior(oid),
-                         reqid=(*reqid, 90) if reqid else None)
-        self.log.append(entry)
-        self.persist_meta()
-        await self.backend.execute_write(oid, "clone", payload, entry)
+        async with self.backend.obj_lock(oid):
+            entry = LogEntry(version=self.next_version(), op="modify",
+                             oid=oid, prior_version=self._prior(oid),
+                             reqid=(*reqid, 90) if reqid else None)
+            self.log.append(entry, complete=False)
+            self.persist_meta()
+            try:
+                await self.backend.execute_write(oid, "clone", payload,
+                                                 entry)
+            finally:
+                self.log.mark_complete(entry.version)
 
     def _prior(self, oid: str) -> Eversion:
-        for e in reversed(self.log.entries):
-            if e.oid == oid:
-                return e.version
-        return ZERO
+        # O(1) via the log's per-object index — the reverse entry scan
+        # ran once per write and dominated the ordered slice at a full
+        # 1000-entry window (profiled under the pipelined hot path)
+        return self.log.last_version_of(oid)

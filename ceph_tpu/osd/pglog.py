@@ -68,6 +68,17 @@ class PGLog:
         # dup-op index: reqid -> version of the entry that executed it
         # (PGLog dups; horizon = the retained entry window)
         self._reqids: dict[tuple, Eversion] = {}
+        # pipelined-execution completion tracking: versions whose
+        # log-intent is appended but whose execution slice has not yet
+        # settled (the primary marks them complete in ANY order;
+        # `last_complete` advances only over the contiguous settled
+        # prefix — the reference's pg_info_t.last_complete)
+        self._incomplete: set[Eversion] = set()
+        # newest retained entry per object (prior_version lookups ran a
+        # reverse scan of the whole window PER WRITE — profiled on the
+        # pipelined hot path); kept in sync with `entries` by
+        # append/insert/trim and rebuilt with the reqid index
+        self._last_by_oid: dict[str, Eversion] = {}
         # incremental-persistence dirty state: persist_meta writes ONE
         # omap key per changed entry instead of re-serializing the whole
         # window per op (the reference stores pg log entries as
@@ -126,13 +137,18 @@ class PGLog:
 
     # -- append path ---------------------------------------------------------
 
-    def append(self, entry: LogEntry) -> None:
+    def append(self, entry: LogEntry, complete: bool = True) -> None:
         assert entry.version > self.head, (entry, self.head)
         self.entries.append(entry)
         self._dirty[self.entry_key(entry.version)] = entry
         self.head = entry.version
+        self._last_by_oid[entry.oid] = entry.version
         if entry.reqid is not None:
             self._reqids[entry.reqid] = entry.version
+        if not complete:
+            # a pipelined primary appends the log INTENT before the
+            # execution slice runs; mark_complete settles it later
+            self._incomplete.add(entry.version)
         if len(self.entries) > self.MAX_ENTRIES:
             drop = len(self.entries) - self.MAX_ENTRIES
             self.tail = self.entries[drop - 1].version
@@ -140,7 +156,62 @@ class PGLog:
                 if e.reqid is not None:
                     self._reqids.pop(e.reqid, None)
                 self._dirty[self.entry_key(e.version)] = None
+                self._incomplete.discard(e.version)
+                # only when the dropped entry IS the object's newest:
+                # a later retained entry keeps the mapping alive
+                if self._last_by_oid.get(e.oid) == e.version:
+                    del self._last_by_oid[e.oid]
             del self.entries[:drop]
+
+    def insert(self, entry: LogEntry) -> None:
+        """Adopt an entry that may arrive OUT OF ORDER: a pipelined
+        primary fans sub-ops for different objects out concurrently, so
+        a replica can see v6 before v5. In-order entries append; an
+        out-of-order entry splices into version position (the old
+        `version > head` guard silently DROPPED it, leaving the replica
+        log with a hole a failover would promote — its dup index would
+        re-execute the lost entry's request)."""
+        if entry.version > self.head:
+            self.append(entry)
+            return
+        if entry.version <= self.tail:
+            return              # trimmed past: implicit
+        lo, hi = 0, len(self.entries)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self.entries[mid].version < entry.version:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < len(self.entries) and \
+                self.entries[lo].version == entry.version:
+            return              # duplicate delivery
+        self.entries.insert(lo, entry)
+        self._dirty[self.entry_key(entry.version)] = entry
+        if entry.version > self._last_by_oid.get(entry.oid, ZERO):
+            self._last_by_oid[entry.oid] = entry.version
+        if entry.reqid is not None:
+            self._reqids[entry.reqid] = entry.version
+
+    def mark_complete(self, version: Eversion) -> None:
+        """The execution slice of `version` settled (committed or
+        failed out to the client for resend) — completions arrive in
+        any order under pipelining."""
+        self._incomplete.discard(tuple(version))
+
+    @property
+    def last_complete(self) -> Eversion:
+        """Newest version with no unsettled predecessor: advances
+        CONTIGUOUSLY no matter what order executions complete in."""
+        if not self._incomplete:
+            return self.head
+        lo = min(self._incomplete)
+        best = self.tail
+        for e in self.entries:
+            if e.version >= lo:
+                break
+            best = e.version
+        return best
 
     def lookup_reqid(self, reqid: tuple) -> Eversion | None:
         """Version recorded for a client request id, if it already
@@ -148,8 +219,17 @@ class PGLog:
         return self._reqids.get(reqid)
 
     def _rebuild_reqids(self) -> None:
+        """Rebuild the derived per-entry indexes (reqid dup table AND
+        the per-object newest-version map) after wholesale entry-list
+        surgery: load, authoritative merge, backfill adoption."""
         self._reqids = {e.reqid: e.version for e in self.entries
                         if e.reqid is not None}
+        self._last_by_oid = {e.oid: e.version for e in self.entries}
+
+    def last_version_of(self, oid: str) -> Eversion:
+        """Newest retained entry version for `oid` (ZERO if none) —
+        the O(1) prior_version lookup."""
+        return self._last_by_oid.get(oid, ZERO)
 
     def invalidate_reqids_for(self, oid: str, newer_than: Eversion) -> None:
         """Divergence rollback rewound this object past these entries:
@@ -196,6 +276,9 @@ class PGLog:
                 else self.tail
             # a rewind invalidates persisted suffix keys: rewrite whole
             self._dirty_full = True
+            self._incomplete = {v for v in self._incomplete
+                                if v <= auth_head}
+            self._last_by_oid = {e.oid: e.version for e in self.entries}
         for e in divergent:
             # latest authoritative version of that object, if any
             auth_v = ZERO

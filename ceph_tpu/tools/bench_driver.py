@@ -723,6 +723,97 @@ def stage_cluster_tpu() -> dict:
             f"{dp_off} MB/s "
             f"({results['ec_datapath_offload_vs_inline']}x)")
 
+    async def pipeline_sweep():
+        """osd_pg_pipeline_depth sweep over the SAME deep-queue
+        workload (pg=8, conc=128, one-stripe objects): depth=1 is the
+        old serial per-PG pipeline (windowed admission takes the
+        legacy inline path, bit-identical by construction — checked by
+        reading a known object back at every depth), and each step up
+        lets one PG run that many client ops to distinct objects
+        concurrently. Records write MB/s, data frames per EC write
+        (deeper per-peer queues => better per-frame amortization of
+        PR-12's batches), the offload batcher's mean batch size
+        (concurrent stripes finally coalesce), and the window-full
+        stall fraction (guarded: a rising stall fraction means the
+        window, not the wire, is the new ceiling)."""
+        from ceph_tpu import offload
+        from ceph_tpu.msg.messenger import msgr_perf
+        from ceph_tpu.tools.cluster_boot import ephemeral_cluster
+        from ceph_tpu.tools.rados_bench import _phase
+
+        DEPTHS = (1, 2, 4, 8)
+        CONC_DEEP = 128
+        sweep: dict[str, float] = {}
+        frames: dict[str, float] = {}
+        batch: dict[str, float] = {}
+        stalls: dict[str, float] = {}
+        readbacks: dict[int, bytes] = {}
+        payload = bytes(range(256)) * (OBJ // 256)
+        offload.set_enabled(True)
+        for depth in DEPTHS:
+            # a FRESH cluster per depth: one shared cluster ages across
+            # the sweep (log windows fill, stores grow), handicapping
+            # whichever depth runs last — the shard curve isolates its
+            # points the same way
+            async with ephemeral_cluster(
+                    K8 + M3, prefix=f"bench-pipe{depth}-") \
+                    as (client, osds, _mon):
+                await client.command({
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "tpuprof",
+                    "profile": {"plugin": "tpu", "k": str(K8),
+                                "m": str(M3)}})
+                await client.pool_create("pipebench", pg_num=8,
+                                         pool_type="erasure",
+                                         erasure_code_profile="tpuprof")
+                io = client.ioctx("pipebench")
+                svc = offload.get_service()
+                pc = msgr_perf()
+                for o in osds:
+                    o.config.set("osd_pg_pipeline_depth", depth)
+                await asyncio.gather(*[io.write_full(f"warm-{i}",
+                                                     payload)
+                                       for i in range(4)])
+                base_m = dict(pc.dump())
+                base_s = dict(svc.stats)
+                base_stalls = sum(o.op_queue.window_stalls for o in osds)
+                counts: dict = {}
+                w = await _phase(io, "write", CONC_DEEP, 2.0, OBJ, counts)
+                dm = {k: v - base_m[k] for k, v in pc.dump().items()
+                      if isinstance(v, int) and k in base_m}
+                ds = {k: svc.stats[k] - base_s[k] for k in base_s}
+                ops = max(1, w["ops"])
+                d = str(depth)
+                sweep[d] = w["mb_per_s"]
+                frames[d] = round(dm.get("data_frames_tx", 0) / ops, 2)
+                batch[d] = round(ds["batched_ops"] / ds["batches"], 3) \
+                    if ds.get("batches") else 0.0
+                stalls[d] = round(
+                    (sum(o.op_queue.window_stalls for o in osds)
+                     - base_stalls) / ops, 4)
+                await io.write_full("bitcheck", payload)
+                readbacks[depth] = bytes(await io.read("bitcheck"))
+                log(f"pipeline_depth={depth}: write {w['mb_per_s']} "
+                    f"MB/s, {frames[d]} frames/write, mean offload "
+                    f"batch {batch[d]}, stall fraction {stalls[d]}")
+        identical = all(rb == readbacks[DEPTHS[0]] == payload
+                        for rb in readbacks.values())
+        results["pipeline_depth_sweep_mb_s"] = sweep
+        results["pipeline_msgr_frames_per_ec_write"] = frames
+        results["pipeline_offload_mean_batch_ops"] = batch
+        results["pipeline_stall_fraction_by_depth"] = stalls
+        results["pipeline_bit_identical"] = identical
+        base = sweep.get("1") or 0.0
+        results["pipeline_speedup_4v1"] = round(
+            (sweep.get("4") or 0.0) / base, 3) if base else 0.0
+        # the guarded figures, taken at the DEFAULT depth (4): window
+        # stall fraction (rise = the window is the new ceiling) rides
+        # next to cluster_ec_write_mb_s / offload_mean_batch_ops
+        results["pg_pipeline_stall_fraction"] = stalls.get("4", 0.0)
+        log(f"pipeline_sweep: {sweep} (4v1 "
+            f"x{results['pipeline_speedup_4v1']}, "
+            f"bit_identical={identical})")
+
     async def shard_curve():
         """Reactor shard scaling: the SAME offload-batched EC write
         workload over 1/2/4-shard reactor runtimes (utils/reactor.py).
@@ -790,6 +881,10 @@ def stage_cluster_tpu() -> dict:
 
     asyncio.run(asyncio.wait_for(body(), 240))
     asyncio.run(asyncio.wait_for(datapath(), 120))
+    try:
+        asyncio.run(asyncio.wait_for(pipeline_sweep(), 180))
+    except Exception as e:
+        log(f"pipeline_sweep: FAILED {type(e).__name__}: {e}")
     try:
         asyncio.run(asyncio.wait_for(shard_curve(), 180))
     except Exception as e:
@@ -1479,7 +1574,8 @@ def stage_attribution() -> dict:
 
 TREND_KEYS = ("tpu_encode", "tpu_decode", "failure_storm_recovery_mb_s",
               "scaling_efficiency", "cluster_ec_write_mb_s",
-              "cluster_ec_tpu_write_mb_s_sharded", "swarm_mb_s")
+              "cluster_ec_tpu_write_mb_s_sharded", "swarm_mb_s",
+              "offload_mean_batch_ops")
 #: keys where UP is the regression direction: more copied bytes per
 #: written byte, a busier event loop, a slower recovery to clean, a
 #: repair fetch creeping back toward the full-stripe baseline, the
@@ -1491,7 +1587,8 @@ TREND_KEYS_COST = ("copy_amplification", "loop_busy_fraction",
                    "failure_storm_repair_ratio",
                    "device_busy_skew", "shard_busy_skew",
                    "swarm_p99_fairness", "python_us_per_op",
-                   "msgr_frames_per_ec_write")
+                   "msgr_frames_per_ec_write",
+                   "pg_pipeline_stall_fraction")
 TREND_THRESHOLD_PCT = 10.0
 
 

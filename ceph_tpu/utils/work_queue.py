@@ -604,6 +604,23 @@ class Finisher:
                                     f"{type(e).__name__}: {e}")
 
 
+class _KeyWindow:
+    """Per-key (per-PG) in-flight execution state of one shard: how many
+    items of each class are running, which object streams are occupied,
+    and whether an exclusive (obj=None) item holds the key."""
+
+    __slots__ = ("counts", "objs", "exclusive")
+
+    def __init__(self):
+        self.counts = collections.Counter()     # klass -> in-flight
+        self.objs: set = set()                  # objects in execution
+        self.exclusive = False                  # obj=None item running
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts.values())
+
+
 class ShardedOpQueue:
     """N shards drained concurrently; work is routed by key hash so
     same-key (same-PG) items keep their order (osd_op_tp semantics).
@@ -613,27 +630,71 @@ class ShardedOpQueue:
     mClockScheduler.h:92, OpSchedulerItem op classes): client traffic
     gets `WEIGHTS["client"]` dequeues for every 1 a background class
     gets, so recovery/backfill can neither starve clients nor be
-    starved by them. FIFO order holds within a class per shard.
+    starved by them.
+
+    Pipelined admission (`pipeline_depth` > 1, the PrimaryLogPG
+    concurrent-op analog): instead of awaiting each item to completion,
+    a shard worker ADMITS up to `pipeline_depth` items per key per
+    class into concurrently-running tasks, with ordering guarantees:
+
+      * FIFO within an object: an item is never started while an
+        earlier same-key item for the same `obj` is queued or running
+        (the obc write-lock ordering — same-object ops serialize in
+        arrival order; different objects of one PG overlap);
+      * an item with `obj=None` is an exclusive barrier for its key
+        WITHIN ITS CLASS: it waits for the key to fully drain, runs
+        alone, and no later item of its class starts until it
+        completes (multi-object/unkeyed ops keep the old whole-PG
+        serial semantics). Admission order ACROSS classes stays
+        WRR-arbitrated, exactly as it was pre-pipelining — a recovery
+        item enqueued after a client barrier may run first, and
+        cannot starve it: recovery serializes per PG with the key
+        going idle between items, at which point the barrier (scanned
+        first, client credits) admits;
+      * windows are per (key, class), so a saturated client window
+        cannot starve recovery admission for the same PG — but object
+        conflicts span classes (a recovery rebuild of X still
+        serializes against a client write of X);
+      * QoS credits are spent at START time only: a class whose head is
+        window-blocked burns no credits, so weighted round robin
+        arbitrates over STARTABLE work (the credit-holding stall bug).
+
+    `pipeline_depth=1` runs the exact legacy path: the worker awaits
+    each item inline, one in flight per shard, bit-identical ordering.
+    Hot-resizable via set_pipeline_depth (the osd_pg_pipeline_depth
+    observer); completions refill the window (completion-driven
+    admission, no polling).
     """
 
     WEIGHTS = {"client": 4, "recovery": 1, "scrub": 1}
 
     def __init__(self, name: str = "osd_op_tp", num_shards: int = 5,
                  hb_map: HeartbeatMap | None = None,
-                 hb_grace: float = 30.0):
+                 hb_grace: float = 30.0, pipeline_depth: int = 1,
+                 perf: "PerfCounters | None" = None):
         self.name = name
         self.num_shards = num_shards
+        # each queued item is (key, obj, work)
         self._queues: list[dict[str, collections.deque]] = [
             {k: collections.deque() for k in self.WEIGHTS}
             for _ in range(num_shards)]
         self._wake = [asyncio.Event() for _ in range(num_shards)]
         self._credits: list[dict[str, int]] = [
             dict(self.WEIGHTS) for _ in range(num_shards)]
+        self._inflight: list[dict] = [{} for _ in range(num_shards)]
+        self._exec_tasks: list[set] = [set() for _ in range(num_shards)]
+        self._stalled = [False] * num_shards
         self._stopping = False
         self._tasks: list[asyncio.Task] = []
         self._hb_map = hb_map
         self._hb_grace = hb_grace
         self._hb_ids: list[int] = []
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        # optional daemon counters: pg_pipeline_inflight gauge +
+        # pg_pipeline_window_stalls (declared by the OSD)
+        self.perf = perf
+        self._inflight_total = 0
+        self.window_stalls = 0
         self.processed = 0
         self.processed_by_class = collections.Counter()
 
@@ -661,6 +722,17 @@ class ShardedOpQueue:
                 if being_cancelled() or not t.done():
                     raise       # a cancelled stop() stays cancellable
         self._tasks.clear()
+        # pipelined executions the workers spawned: _run_one swallows
+        # work exceptions, so awaiting these only propagates our own
+        # cancellation — nothing may stay pending past stop()
+        for tasks in self._exec_tasks:
+            for t in list(tasks):
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    if being_cancelled() or not t.done():
+                        raise
+            tasks.clear()
         for hid in self._hb_ids:
             self._hb_map.remove_worker(hid)
         self._hb_ids.clear()
@@ -668,44 +740,183 @@ class ShardedOpQueue:
     def shard_of(self, key) -> int:
         return hash(key) % self.num_shards
 
+    def set_pipeline_depth(self, depth: int) -> None:
+        """Hot-resize the per-PG execution window (config observer).
+        Growing wakes every shard so blocked work admits immediately;
+        shrinking takes effect as in-flight items complete."""
+        self.pipeline_depth = max(1, int(depth))
+        for ev in self._wake:
+            ev.set()
+
+    def total_in_flight(self) -> int:
+        """Items currently in pipelined execution across all shards."""
+        return self._inflight_total
+
+    def in_flight(self, key) -> int:
+        """Items of `key` currently in execution (window occupancy)."""
+        st = self._inflight[self.shard_of(key)].get(key)
+        return st.total if st is not None else 0
+
     def enqueue(self, key, work: Callable[[], Awaitable],
-                klass: str = "client") -> None:
-        """Queue an async thunk on the shard owning `key`."""
+                klass: str = "client", obj=None) -> None:
+        """Queue an async thunk on the shard owning `key`. `obj` names
+        the object stream the item belongs to (same-obj items stay
+        FIFO); None makes the item an exclusive barrier for its key."""
         shard = self.shard_of(key)
-        self._queues[shard][klass].append(work)
+        self._queues[shard][klass].append((key, obj, work))
         self._wake[shard].set()
 
-    def _pick(self, shard: int) -> Callable | None:
-        """Weighted round robin: spend class credits in weight order;
-        refill when every non-empty class is out of credits."""
-        queues, credits = self._queues[shard], self._credits[shard]
-        for _ in range(2):
-            for klass in self.WEIGHTS:
-                if queues[klass] and credits[klass] > 0:
-                    credits[klass] -= 1
-                    self.processed_by_class[klass] += 1
-                    return queues[klass].popleft()
-            # out of credits for every backlogged class: refill
-            self._credits[shard] = dict(self.WEIGHTS)
-            credits = self._credits[shard]
+    # -- admission -----------------------------------------------------------
+
+    def _startable(self, infl: dict, key, obj, klass: str,
+                   depth: int) -> bool:
+        st = infl.get(key)
+        if st is None:
+            return True
+        if st.exclusive or st.counts[klass] >= depth:
+            return False
+        if obj is None:
+            return st.total == 0        # barrier: needs the key idle
+        return obj not in st.objs
+
+    def _scan(self, q: collections.deque, infl: dict, klass: str,
+              depth: int) -> tuple | None:
+        """First startable item of one class queue, honoring per-object
+        FIFO: a skipped item shadows everything behind it that must not
+        overtake it (its object stream; its whole key when the skip was
+        a full window or a waiting barrier).
+
+        O(queued) per admission — acceptable at OSD queue depths (a
+        shard's class backlog is client-concurrency / (osds × shards));
+        if deep backlogs ever profile here, the structural fix is
+        per-key subqueues with a ready list so blocked streams are
+        skipped without rescanning."""
+        blocked_keys: set = set()
+        blocked_objs: set = set()
+        for i, (key, obj, work) in enumerate(q):
+            if key in blocked_keys:
+                continue
+            if obj is not None and (key, obj) in blocked_objs:
+                continue
+            if self._startable(infl, key, obj, klass, depth):
+                del q[i]
+                return key, obj, work
+            if obj is None:
+                # a waiting barrier: nothing behind it for this key
+                # may overtake (it is a sync point)
+                blocked_keys.add(key)
+                continue
+            st = infl.get(key)
+            if st is not None and (st.exclusive
+                                   or st.counts[klass] >= depth):
+                blocked_keys.add(key)   # whole window full
+            else:
+                blocked_objs.add((key, obj))
         return None
 
+    def _admit(self, shard: int, klass: str, key, obj) -> None:
+        st = self._inflight[shard].setdefault(key, _KeyWindow())
+        st.counts[klass] += 1
+        if obj is None:
+            st.exclusive = True
+        else:
+            st.objs.add(obj)
+        self._inflight_total += 1
+        if self.perf is not None:
+            self.perf.set("pg_pipeline_inflight", self._inflight_total)
+
+    def _complete(self, shard: int, klass: str, key, obj) -> None:
+        infl = self._inflight[shard]
+        st = infl.get(key)
+        if st is not None:
+            st.counts[klass] -= 1
+            if obj is None:
+                st.exclusive = False
+            else:
+                st.objs.discard(obj)
+            if st.total <= 0:
+                del infl[key]
+        self._inflight_total -= 1
+        if self.perf is not None:
+            self.perf.set("pg_pipeline_inflight", self._inflight_total)
+        self._wake[shard].set()         # completion-driven refill
+
+    def _pick(self, shard: int) -> tuple | None:
+        """Weighted round robin over STARTABLE work: class credits are
+        spent only when an item actually admits (a window-blocked class
+        holds its credits — satellite audit: the old picker charged the
+        class before knowing the item could run); refill when no
+        credited class can start anything. Sets the shard's stall flag
+        when queued work existed but every item was window-blocked."""
+        queues, credits = self._queues[shard], self._credits[shard]
+        infl = self._inflight[shard]
+        depth = self.pipeline_depth
+        self._stalled[shard] = False
+        blocked = False
+        for attempt in range(2):
+            blocked = False
+            for klass in self.WEIGHTS:
+                if not queues[klass] or credits[klass] <= 0:
+                    continue
+                item = self._scan(queues[klass], infl, klass, depth)
+                if item is None:
+                    blocked = True
+                    continue
+                credits[klass] -= 1
+                self.processed_by_class[klass] += 1
+                self._admit(shard, klass, *item[:2])
+                return (klass, *item)
+            # nothing admitted on credits: refill and retry once (an
+            # uncredited class may hold startable work); a second dry
+            # pass with blocked work means everything queued is
+            # window-blocked
+            self._credits[shard] = dict(self.WEIGHTS)
+            credits = self._credits[shard]
+        self._stalled[shard] = blocked
+        return None
+
+    async def _run_one(self, shard: int, klass: str, key, obj,
+                       work) -> None:
+        try:
+            await work()
+        except Exception as e:
+            dout("osd", 1, f"{self.name}.{shard}: work raised "
+                           f"{type(e).__name__}: {e}")
+        finally:
+            self.processed += 1
+            self._complete(shard, klass, key, obj)
+
     async def _worker(self, shard: int) -> None:
+        loop = asyncio.get_running_loop()
         while True:
-            work = self._pick(shard)
-            if work is None:
-                if self._stopping:
+            picked = self._pick(shard)
+            if picked is None:
+                if self._stopping and \
+                        not any(self._queues[shard].values()):
                     return
                 self._wake[shard].clear()
-                if any(self._queues[shard].values()):
-                    continue        # raced a concurrent enqueue
+                picked = self._pick(shard)      # close the enqueue race
+            if picked is None:
+                if self._stopping and \
+                        not any(self._queues[shard].values()):
+                    return
+                if self._stalled[shard]:
+                    # queued work exists but every item is blocked
+                    # behind a full window: a completion will wake us
+                    self.window_stalls += 1
+                    if self.perf is not None:
+                        self.perf.inc("pg_pipeline_window_stalls")
                 await self._wake[shard].wait()
                 continue
+            klass, key, obj, work = picked
             if self._hb_ids:
                 self._hb_map.touch(self._hb_ids[shard])
-            try:
-                await work()
-            except Exception as e:
-                dout("osd", 1, f"{self.name}.{shard}: work raised "
-                               f"{type(e).__name__}: {e}")
-            self.processed += 1
+            if self.pipeline_depth <= 1:
+                # legacy serial path: bit-identical to the pre-pipeline
+                # queue (one in-flight item per shard, awaited inline)
+                await self._run_one(shard, klass, key, obj, work)
+            else:
+                t = loop.create_task(
+                    self._run_one(shard, klass, key, obj, work))
+                self._exec_tasks[shard].add(t)
+                t.add_done_callback(self._exec_tasks[shard].discard)

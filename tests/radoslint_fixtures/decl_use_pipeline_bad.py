@@ -1,0 +1,15 @@
+"""Known-positive decl-use: the PG-pipelining surface rotted — a
+pipeline knob no code path reads (tuning the window changes nothing)
+and a pipeline counter that would graph forever-zero."""
+
+
+class PerfCounters:        # base stub: the lint keys on the base NAME
+    pass
+
+
+class GhostPipelineCounters(PerfCounters):
+    def __init__(self, config, Option):
+        config.declare(Option("osd_pg_pipeline_burst_dead", "int", 4,
+                              "a window knob nobody consults"))
+        self.add("pg_pipeline_ghost_stalls",
+                 description="pipeline counter never incremented")

@@ -1,0 +1,36 @@
+"""Known-negative decl-use: the PG-pipelining surface declared the way
+osd/daemon.py + utils/work_queue.py really declare it — the depth knob
+read at queue construction AND hot-applied through an observer, and the
+window counters declared on the daemon's perf handle and set/incremented
+on the admission path."""
+
+
+def register_config(config, Option, queue):
+    config.declare(Option("osd_pg_pipeline_depth", "int", 4,
+                          "applied via the observer below"))
+    queue.pipeline_depth = config.get("osd_pg_pipeline_depth")
+
+    def _on_change(name, value):
+        queue.set_pipeline_depth(int(value))
+
+    config.add_observer(("osd_pg_pipeline_depth",), _on_change)
+
+
+class Queue:
+    """Window accounting against the daemon's perf counters: admit()
+    tracks occupancy, a blocked pick records the stall."""
+
+    def __init__(self, perf):
+        self.perf = perf
+        self.perf.add("pg_pipeline_inflight",
+                      description="set on every admit/complete below")
+        self.perf.add("pg_pipeline_window_stalls",
+                      description="incremented on window-full parks")
+        self.in_flight = 0
+
+    def admit(self):
+        self.in_flight += 1
+        self.perf.set("pg_pipeline_inflight", self.in_flight)
+
+    def stall(self):
+        self.perf.inc("pg_pipeline_window_stalls")

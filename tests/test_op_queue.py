@@ -120,6 +120,185 @@ def test_ops_parked_during_peering_complete(tmp_path):
     run(body())
 
 
+def test_pipelined_window_distinct_objects_overlap():
+    """depth=4: one PG's ops to DISTINCT objects run concurrently up to
+    the window; the 5th waits for a completion (completion-driven
+    refill), and same-object ops stay strictly FIFO."""
+    async def body():
+        q = ShardedOpQueue(num_shards=1, pipeline_depth=4)
+        q.start()
+        running: set[str] = set()
+        peak = [0]
+        gates = {f"o{i}": asyncio.Event() for i in range(6)}
+        done: list[str] = []
+
+        async def item(obj):
+            running.add(obj)
+            peak[0] = max(peak[0], len(running))
+            await gates[obj].wait()
+            running.discard(obj)
+            done.append(obj)
+
+        for i in range(5):
+            q.enqueue("pg", lambda i=i: item(f"o{i}"), obj=f"o{i}")
+        await asyncio.sleep(0.05)
+        # exactly the window is admitted; o4 is parked window-full
+        assert running == {"o0", "o1", "o2", "o3"}, running
+        assert q.in_flight("pg") == 4
+        assert q.window_stalls >= 1          # the parked 5th stalled
+        gates["o1"].set()                    # completion refills
+        await asyncio.sleep(0.05)
+        assert "o4" in running
+        for g in gates.values():
+            g.set()
+        deadline = asyncio.get_running_loop().time() + 5
+        while len(done) < 5:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        await q.stop()
+        assert peak[0] == 4 and q.total_in_flight() == 0
+    run(body())
+
+
+def test_pipelined_same_object_fifo_and_barrier():
+    """Same-obj items never overlap and run in submission order even
+    when later different-obj items overtake; an obj=None barrier drains
+    the key, runs alone, and holds everything behind it."""
+    async def body():
+        q = ShardedOpQueue(num_shards=1, pipeline_depth=8)
+        q.start()
+        log: list[tuple[str, str]] = []
+        gate = asyncio.Event()
+
+        async def item(tag, obj, wait=False):
+            log.append(("start", tag))
+            assert sum(1 for k, t in log if k == "start" and t == tag) \
+                - sum(1 for k, t in log if k == "end" and t == tag) == 1
+            if wait:
+                await gate.wait()
+            log.append(("end", tag))
+
+        q.enqueue("pg", lambda: item("x1", "x", wait=True), obj="x")
+        q.enqueue("pg", lambda: item("x2", "x"), obj="x")
+        q.enqueue("pg", lambda: item("y1", "y"), obj="y")
+        q.enqueue("pg", lambda: item("bar", None))        # barrier
+        q.enqueue("pg", lambda: item("z1", "z"), obj="z")
+        await asyncio.sleep(0.05)
+        started = [t for k, t in log if k == "start"]
+        # x2 is behind x1 (same obj, blocked); y1 overtook; the barrier
+        # and everything behind it wait for the key to drain
+        assert "x1" in started and "y1" in started
+        assert "x2" not in started and "bar" not in started \
+            and "z1" not in started, log
+        gate.set()
+        deadline = asyncio.get_running_loop().time() + 5
+        while len([1 for k, _ in log if k == "end"]) < 5:
+            assert asyncio.get_running_loop().time() < deadline, log
+            await asyncio.sleep(0.01)
+        await q.stop()
+        started = [t for k, t in log if k == "start"]
+        # per-object FIFO: x1 before x2; barrier after the drain,
+        # strictly before z1
+        assert started.index("x1") < started.index("x2")
+        assert started.index("bar") > max(started.index("x2"),
+                                          started.index("y1"))
+        assert started.index("z1") > started.index("bar")
+        # the barrier ran ALONE: nothing started between its start/end
+        bs = log.index(("start", "bar"))
+        assert log[bs + 1] == ("end", "bar"), log[bs:bs + 2]
+    run(body())
+
+
+def test_recovery_not_starved_by_full_client_window():
+    """Satellite regression (weighted-round-robin invariant under
+    pipelining): windows are per (key, class) and QoS credits are spent
+    only on items that actually START — with the PG's CLIENT window
+    saturated and more client work queued, a recovery op for the same
+    PG must still be admitted and complete."""
+    async def body():
+        q = ShardedOpQueue(num_shards=1, pipeline_depth=2)
+        q.start()
+        gate = asyncio.Event()
+        recovered = asyncio.Event()
+
+        async def client_item(obj):
+            await gate.wait()
+
+        async def recovery_item():
+            recovered.set()
+
+        # saturate the client window and pile queued client work on top
+        for i in range(6):
+            q.enqueue("pg", lambda i=i: client_item(f"c{i}"), obj=f"c{i}")
+        await asyncio.sleep(0.02)
+        assert q.in_flight("pg") == 2
+        q.enqueue("pg", recovery_item, klass="recovery", obj="rec-obj")
+        await asyncio.wait_for(recovered.wait(), 5)
+        assert not gate.is_set()        # clients still wedged: no starve
+        gate.set()
+        deadline = asyncio.get_running_loop().time() + 5
+        while q.processed < 7:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        await q.stop()
+        assert q.processed_by_class["recovery"] == 1
+    run(body())
+
+
+def test_depth1_is_the_legacy_serial_path():
+    """pipeline_depth=1 is bit-identical to the pre-pipeline queue: one
+    item in flight per shard, awaited inline — even DIFFERENT keys on
+    one shard never overlap."""
+    async def body():
+        q = ShardedOpQueue(num_shards=1, pipeline_depth=1)
+        q.start()
+        active = [0]
+        peak = [0]
+
+        async def item():
+            active[0] += 1
+            peak[0] = max(peak[0], active[0])
+            await asyncio.sleep(0.01)
+            active[0] -= 1
+
+        for i in range(4):
+            q.enqueue(f"key{i}", item, obj=f"obj{i}")
+        deadline = asyncio.get_running_loop().time() + 5
+        while q.processed < 4:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        await q.stop()
+        assert peak[0] == 1
+    run(body())
+
+
+def test_pipeline_depth_hot_resize_admits_blocked_work():
+    async def body():
+        q = ShardedOpQueue(num_shards=1, pipeline_depth=2)
+        q.start()
+        gate = asyncio.Event()
+        started: list[str] = []
+
+        async def item(tag):
+            started.append(tag)
+            await gate.wait()
+
+        for tag in ("a", "b", "c"):
+            q.enqueue("pg", lambda tag=tag: item(tag), obj=tag)
+        await asyncio.sleep(0.02)
+        assert started == ["a", "b"]     # window of 2: c parked
+        q.set_pipeline_depth(4)          # the hot observer path
+        await asyncio.sleep(0.05)
+        assert "c" in started            # resize admitted it live
+        gate.set()
+        deadline = asyncio.get_running_loop().time() + 5
+        while q.processed < 3:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        await q.stop()
+    run(body())
+
+
 def test_weighted_classes_share_a_shard():
     """mClock-lite: with both classes backlogged on one shard, client
     work gets WEIGHTS['client'] dequeues per recovery dequeue — neither

@@ -46,6 +46,8 @@ def lint(path, rules):
      "decl_use_offload_good.py"),
     ("decl-use", "decl_use_clients_bad.py", 2,
      "decl_use_clients_good.py"),
+    ("decl-use", "decl_use_pipeline_bad.py", 2,
+     "decl_use_pipeline_good.py"),
     ("report-export-consistency", "report_export_bad.py", 1,
      "report_export_good.py"),
 ])
