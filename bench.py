@@ -53,6 +53,7 @@ CLUSTER_TPU_TIMEOUT = 620  # in-situ EC-over-tpu cluster stage: body
 ATTRIBUTION_TIMEOUT = 240  # hermetic attribution-profiler stage
 FAILURE_STORM_TIMEOUT = 320  # kill/revive resilience + repair-ratio stage
 SWARM_TIMEOUT = 320  # 200-client multi-tenant fairness + SLO pipeline stage
+INTERLEAVE_TIMEOUT = 300  # seed-swept schedule explorer + sanitizer overhead
 METRIC = "ec_encode_k8m3_1MiB_chunk"
 
 _deadline = time.monotonic() + TOTAL_BUDGET
@@ -205,6 +206,16 @@ def main() -> int:
     swarm = run_stage("swarm", _hermetic_env(), _budget(SWARM_TIMEOUT))
     stages["swarm"] = swarm
 
+    # Stage 7: interlock qa sweep — seeded schedule exploration over a
+    # pipelined EC cluster, explorer-only vs explorer+sanitizer
+    # (generation guards, lockset recorder): seeds run, distinct
+    # schedules explored, and the sanitizer-mode overhead % the trend
+    # guard watches. Hermetic: it measures the qa tier's cost, not
+    # codec speed.
+    ilv = run_stage("interleave", _hermetic_env(),
+                    _budget(INTERLEAVE_TIMEOUT))
+    stages["interleave"] = ilv
+
     detail = {k: v for k, v in cpu.items()
               if k not in ("status", "elapsed_s", "stderr_tail")}
     detail.update({k: v for k, v in cluster.items()
@@ -218,6 +229,8 @@ def main() -> int:
     detail.update({k: v for k, v in storm.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})
     detail.update({k: v for k, v in swarm.items()
+                   if k not in ("status", "elapsed_s", "stderr_tail")})
+    detail.update({k: v for k, v in ilv.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})
     detail.update({k: v for k, v in device.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})

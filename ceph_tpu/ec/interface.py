@@ -19,6 +19,8 @@ from typing import Iterable, Mapping, Sequence
 
 import numpy as np
 
+from ceph_tpu.utils import sanitizer
+
 # Reference pads chunks to SIMD_ALIGN=32 (ErasureCode.cc:42). TPU lane tiles
 # want the byte axis in multiples of 128; padding is imposed through
 # get_chunk_size, the sanctioned place per ErasureCodeIsa.cc:66-78.
@@ -229,6 +231,7 @@ class ErasureCode(ErasureCodeInterface):
         set (lrc's sparse layouts); all other positions are zero-initialized
         coding chunks.
         """
+        data = sanitizer.unwrap(data)   # numpy boundary: checked unwrap
         chunk_size = self.get_chunk_size(len(data))
         mapping = self.get_chunk_mapping()
         chunks: dict[int, np.ndarray] = {

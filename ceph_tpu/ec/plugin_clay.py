@@ -35,6 +35,7 @@ from ceph_tpu.ec.interface import ErasureCode, ErasureCodeError
 from ceph_tpu.ec.registry import (ERASURE_CODE_VERSION, ErasureCodePlugin,
                                   ErasureCodePluginRegistry)
 from ceph_tpu.ops import rs_codec
+from ceph_tpu.utils import sanitizer
 
 __erasure_code_version__ = ERASURE_CODE_VERSION
 
@@ -400,7 +401,8 @@ class ErasureCodeClay(ErasureCode):
         for i in range(self.k + self.m):
             node = self._grid_id(i)
             if i in chunks:
-                buf = np.frombuffer(chunks[i], dtype=np.uint8)
+                buf = np.frombuffer(sanitizer.unwrap(chunks[i]),
+                                    dtype=np.uint8)
                 if buf.size != repair_blocksize:
                     raise ErasureCodeError(
                         f"helper {i} has {buf.size} bytes, expected "

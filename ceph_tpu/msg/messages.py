@@ -18,7 +18,7 @@ import json
 import time
 from typing import Any
 
-from ceph_tpu.utils import copytrack
+from ceph_tpu.utils import copytrack, sanitizer
 
 _REGISTRY: dict[int, type] = {}
 
@@ -71,7 +71,11 @@ class Message:
                             separators=(",", ":")).encode()
         payload = json.dumps(self.payload, separators=(",", ":"),
                              sort_keys=True).encode()
-        segments = [header, payload, self.data]
+        # tx boundary: a forwarded sanitizer-guarded rx view (e.g. the
+        # replicated backend fanning client data out as MOSDRepOp)
+        # unwraps HERE with its use-after-recycle check — the frame
+        # codec and transport take raw buffers
+        segments = [header, payload, sanitizer.unwrap(self.data)]
         if self.trace is not None:
             from ceph_tpu.msg.frames import encode_trace_ctx
             segments.append(encode_trace_ctx(self.trace))
@@ -92,6 +96,11 @@ class Message:
             data = bytes(data)
             copytrack.copied("frame_rx", len(data),
                              time.perf_counter() - t0)
+        elif cls.DATA_VIEW and sanitizer.view_guards_active():
+            # sanitizer mode: the zero-copy window over the rx body is
+            # handed out generation-guarded, so a view that outlives a
+            # (future pooled) body recycle raises at the access site
+            data = sanitizer.guard_view(data, label="frame_rx")
         msg = cls.__new__(cls)
         Message.__init__(msg, _json_seg(segments[1]), data)
         msg.seq = header["seq"]
@@ -219,7 +228,9 @@ def pack_batch(msgs: list) -> Message:
             e["tr"] = m.trace
         entries.append(e)
         if len(m.data):
-            datas.append(m.data)
+            # tx boundary (see encode_segments): checked unwrap of any
+            # guarded rx view being forwarded into the scatter segment
+            datas.append(sanitizer.unwrap(m.data))
     cls = MOSDECSubOpBatchReply \
         if all(m.TYPE in BATCH_REPLY_TYPES for m in msgs) \
         else MOSDECSubOpBatch

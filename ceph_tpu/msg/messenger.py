@@ -43,7 +43,7 @@ from typing import Awaitable, Callable
 from ceph_tpu.msg import messages as _messages
 from ceph_tpu.msg.frames import BANNER, Frame, FrameError, Tag, Onwire
 from ceph_tpu.msg.messages import Message, _json_seg
-from ceph_tpu.qa import faultinject
+from ceph_tpu.qa import faultinject, interleave
 from ceph_tpu.utils import tracer
 from ceph_tpu.utils.async_util import being_cancelled, drain_all, reap, \
     reap_all
@@ -621,6 +621,10 @@ class Connection:
         fault; acks advance only after a handler completes."""
         while not self._closed:
             gen, msg = await self._dispatch_q.get()
+            if interleave.armed():
+                # schedule explorer: stretch the window between dequeue
+                # and handler so reordered completions really interleave
+                await interleave.yield_point("msgr_dispatch")
             try:
                 if msg.trace is not None and tracer.enabled():
                     # receiving-end messenger span: covers the handler,

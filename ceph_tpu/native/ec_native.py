@@ -69,6 +69,11 @@ def crc32c(data: bytes | np.ndarray, crc: int = 0xFFFFFFFF) -> int:
     (frombuffer + ctypes cast) cost ~25us per call and showed up on
     every message frame (profiled on the cluster bench)."""
     global _crc_fast
+    if type(data).__name__ == "GuardedView":
+        # sanitizer-guarded rx view: checked unwrap at the native
+        # boundary (lazy import — native must not hard-depend on utils)
+        from ceph_tpu.utils.sanitizer import unwrap
+        data = unwrap(data)
     if _crc_fast is None:
         lib = native.load()
         fast = ctypes.CFUNCTYPE(ctypes.c_uint32, ctypes.c_uint32,

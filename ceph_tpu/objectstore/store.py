@@ -15,7 +15,7 @@ import time
 from typing import Callable, Iterable, Mapping
 
 from ceph_tpu.objectstore.types import CollectionId, Ghobject
-from ceph_tpu.utils import tracer
+from ceph_tpu.utils import sanitizer, tracer
 
 NO_SHARD = -1
 
@@ -79,6 +79,10 @@ class Transaction:
         # receive path delivers — pass through by reference: bytes()
         # here silently re-copied every full payload, exactly the copy
         # the rx discipline removed (and invisibly to the copy ledger).
+        # A sanitizer-guarded rx view unwraps first (with its
+        # use-after-recycle check) so it keeps the by-reference path
+        # instead of being silently bytes()-copied below.
+        data = sanitizer.unwrap(data)
         if not isinstance(data, bytes) and \
                 not (isinstance(data, memoryview) and data.readonly):
             data = bytes(data)

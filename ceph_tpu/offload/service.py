@@ -72,8 +72,8 @@ from typing import Any, Callable
 
 import numpy as np
 
-from ceph_tpu.qa import faultinject
-from ceph_tpu.utils import copytrack, tracer
+from ceph_tpu.qa import faultinject, interleave
+from ceph_tpu.utils import copytrack, sanitizer, tracer
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import (TYPE_GAUGE, TYPE_HISTOGRAM,
                                           PerfCountersCollection)
@@ -227,7 +227,10 @@ class _DeviceState:
     def __init__(self, label: str, jdev):
         self.label = label
         self.jdev = jdev                 # jax device, or None = host lane
-        self.lock = threading.Lock()
+        # lockset-recorded (sanitizer TSan-lite): breaker evidence
+        # arrives from every shard thread, and the recorder proves the
+        # "transitions take lock" contract at runtime
+        self.lock = sanitizer.make_lock(f"devstate:{label}")
         self.degraded = False
         self.degraded_since = 0.0
         self.consec_failures = 0
@@ -245,7 +248,7 @@ class _Topology:
     behavior, unchanged)."""
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = sanitizer.make_lock("offload_topology")
         self.states: list[_DeviceState] | None = None
         self.mesh = None
         self.mesh_fns: dict[tuple, Callable] = {}
@@ -253,8 +256,15 @@ class _Topology:
         self.mesh_degraded_since = 0.0
         self.mesh_probe_inflight = False
 
+    def note(self, field: str, write: bool) -> None:
+        """Lockset-recorder tap: every shard thread touches this
+        topology, so each field access feeds the sanitizer's TSan-lite
+        conflict analysis (no-op unless recording is armed)."""
+        sanitizer.note_shared_access(self, field, write)
+
     def reset(self) -> None:
         with self.lock:
+            self.note("states", write=True)
             self.states = None
             self.mesh = None
             self.mesh_fns.clear()
@@ -270,6 +280,7 @@ class _Topology:
         every shard (a racing duplicate build is discarded, which is
         benign)."""
         with self.lock:
+            self.note("states", write=False)
             if self.states is not None:
                 return self.states
         states: list[_DeviceState] = []
@@ -299,6 +310,7 @@ class _Topology:
                                    f"({type(e).__name__}: {e}); "
                                    f"single-device dispatch only")
         with self.lock:
+            self.note("states", write=True)
             if self.states is None:       # first finisher publishes
                 self.states = states
                 self.mesh = mesh
@@ -310,12 +322,14 @@ class _Topology:
         outside the lock (same reasoning as device_states; a racing
         double-compile loses to setdefault)."""
         with self.lock:
+            self.note("mesh_fns", write=False)
             fn = self.mesh_fns.get(cache_key)
             mesh = self.mesh
         if fn is None:
             from ceph_tpu.parallel import mesh as mesh_lib
             built = mesh_lib.sharded_apply_fn(mesh, M)
             with self.lock:
+                self.note("mesh_fns", write=True)
                 fn = self.mesh_fns.setdefault(cache_key, built)
         return fn
 
@@ -407,11 +421,22 @@ class _DeviceSlot:
                     best < 0 or a.nbytes < self.staging[best].nbytes):
                 best = i
         if best >= 0:
-            return self.staging.pop(best)
-        return np.empty(1 << max(12, (nbytes - 1).bit_length()),
-                        dtype=np.uint8)
+            buf = self.staging.pop(best)
+        else:
+            buf = np.empty(1 << max(12, (nbytes - 1).bit_length()),
+                           dtype=np.uint8)
+        if sanitizer.view_guards_active():
+            # generation-track the page: views handed out against this
+            # hand-out go stale at the put_staging recycle point
+            sanitizer.register_buffer(buf, "staging")
+        return buf
 
     def put_staging(self, buf: np.ndarray) -> None:
+        if sanitizer.view_guards_active():
+            # recycle point: the finished batch's views over this page
+            # are dead from here — a straggler access raises instead of
+            # reading the next batch's stripe
+            sanitizer.recycle_buffer(buf)
         self.staging.append(buf)
         while len(self.staging) > self.depth:
             # keep the largest buffers (they satisfy every batch size).
@@ -1131,6 +1156,7 @@ class OffloadService:
         if topo.mesh is None:
             return False
         with topo.lock:
+            topo.note("mesh_degraded", write=False)
             if not topo.mesh_degraded:
                 return True
             if (time.monotonic() - topo.mesh_degraded_since
@@ -1139,6 +1165,7 @@ class OffloadService:
                 # half-open: claim the single probe batch (one claim
                 # ACROSS shards — the lock makes it atomic); cleared on
                 # the probe's success, failure, or cancellation
+                topo.note("mesh_degraded", write=True)
                 topo.mesh_probe_inflight = True
                 return True
             return False
@@ -1149,6 +1176,10 @@ class OffloadService:
                         ) -> tuple[np.ndarray, str]:
         """One staged dispatch with per-device failover and host-codec
         last resort. Returns (result, device label: slot/"mesh"/"host")."""
+        if interleave.armed():
+            # schedule explorer: let a racing batch reach the breaker/
+            # staging state between routing and dispatch
+            await interleave.yield_point("offload_dispatch")
         nbytes = int(stacked.nbytes)
         if not bucket.uses_device:
             t0 = time.perf_counter()
@@ -1174,6 +1205,7 @@ class OffloadService:
                     lambda b: bucket.shard_dispatch(b), stacked)
                 busy = time.perf_counter() - t0
                 with topo.lock:
+                    topo.note("mesh_degraded", write=True)
                     topo.mesh_probe_inflight = False
                     if topo.mesh_degraded:
                         topo.mesh_degraded = False
@@ -1195,6 +1227,7 @@ class OffloadService:
                 raise
             except Exception as e:
                 with topo.lock:
+                    topo.note("mesh_degraded", write=True)
                     topo.mesh_probe_inflight = False
                     topo.mesh_degraded = True
                     topo.mesh_degraded_since = time.monotonic()

@@ -26,7 +26,7 @@ from typing import Iterable, Mapping
 import numpy as np
 
 from ceph_tpu.ec.interface import ErasureCodeError
-from ceph_tpu.utils import copytrack, tracer
+from ceph_tpu.utils import copytrack, sanitizer, tracer
 
 
 class StripeInfo:
@@ -109,6 +109,9 @@ class StripeInfo:
 def _encode_frame(sinfo: StripeInfo, ec_impl, data, want):
     """Shared validation/framing for encode(): returns
     (stripes (S,k,C) | None, want set, k, n_chunks, mapping, batched)."""
+    # numpy boundary: a sanitizer-guarded rx view unwraps HERE (with
+    # its use-after-recycle check) — np.frombuffer can't take the proxy
+    data = sanitizer.unwrap(data)
     if isinstance(data, (bytes, bytearray, memoryview)):
         # np.frombuffer windows the message bytes — no copy
         buf = np.frombuffer(data, dtype=np.uint8)
@@ -297,7 +300,7 @@ def _decode_concat_frame(sinfo: StripeInfo, ec_impl,
     exactly one is non-None; `work` is (stacked, avail_ids, missing,
     want, k, n_stripes, mapping)."""
     k = ec_impl.get_data_chunk_count()
-    arrays = {i: np.frombuffer(b, dtype=np.uint8)
+    arrays = {i: np.frombuffer(sanitizer.unwrap(b), dtype=np.uint8)
               for i, b in to_decode.items()}
     if not arrays:
         raise ErasureCodeError("no chunks to decode")
@@ -418,7 +421,7 @@ def _decode_shards_frame(sinfo: StripeInfo, ec_impl,
     sliced by that plan — contiguous chunk thirds are not the plan's
     strided sub-chunk runs, and the mis-slice would silently decode
     garbage (and inflate the output q-fold)."""
-    arrays = {i: np.frombuffer(b, dtype=np.uint8)
+    arrays = {i: np.frombuffer(sanitizer.unwrap(b), dtype=np.uint8)
               for i, b in to_decode.items()}
     if not arrays:
         raise ErasureCodeError("no chunks to decode")

@@ -34,6 +34,7 @@ from ceph_tpu.msg.messages import (Message, MOSDPGInfo, MOSDPGLog,
 from ceph_tpu.objectstore.store import StoreError, Transaction
 from ceph_tpu.objectstore.types import CollectionId, Ghobject
 from ceph_tpu.osd.pglog import ZERO, Eversion, LogEntry, PGLog
+from ceph_tpu.qa import interleave
 from ceph_tpu.utils import tracer
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.work_queue import WRITE_OP_KINDS, mark_op_event
@@ -1379,6 +1380,11 @@ class PGInstance:
         async with self.backend.obj_lock(oid):
             version, entry = self._log_intent(kind, oid, op)
             try:
+                if interleave.armed():
+                    # schedule explorer: widen the gap between the
+                    # ordered slice and the execution slice, where
+                    # pipelined same-PG ops genuinely overlap
+                    await interleave.yield_point("pg_execute")
                 await self.backend.execute_write(oid, kind, data, entry,
                                                  off=op.get("off", 0))
             finally:

@@ -1,10 +1,10 @@
 """radoslint — AST-based asyncio/lockdep sanitizer suite.
 
 The static half of the reference's race tooling (src/common/lockdep.cc,
-ceph-dencoder's registry cross-checks): six checkers tuned to this
-codebase's real failure modes, a per-file finding model with inline
-suppressions, and a committed baseline so the tier-1 gate only ever
-ratchets toward zero.
+ceph-dencoder's registry cross-checks): the asyncio/lockdep checkers,
+the interlock zero-copy lifetime + cross-shard dataflow rules
+(lifetimes.py), a per-file finding model with inline suppressions, and
+a committed baseline so the tier-1 gate only ever ratchets toward zero.
 
     from ceph_tpu.tools.radoslint import run_lint
     findings = run_lint(["ceph_tpu"], root=repo_root)
@@ -12,7 +12,8 @@ ratchets toward zero.
 from ceph_tpu.tools.radoslint.core import (Finding, RULES, find_baseline,
                                            load_baseline, run_lint,
                                            write_baseline)
-from ceph_tpu.tools.radoslint import checkers, project  # noqa: F401
+from ceph_tpu.tools.radoslint import (checkers, lifetimes,  # noqa: F401
+                                      project)
 
 __all__ = ["Finding", "RULES", "run_lint", "find_baseline",
            "load_baseline", "write_baseline"]

@@ -47,7 +47,8 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     # loads the checker modules (fills core.RULES) as a side effect
-    from ceph_tpu.tools.radoslint import checkers, project  # noqa: F401
+    from ceph_tpu.tools.radoslint import (checkers, lifetimes,  # noqa: F401
+                                          project)
     if args.list_rules:
         for r in sorted(core.RULES.values(), key=lambda r: r.id):
             print(f"{r.id} ({r.kind})")

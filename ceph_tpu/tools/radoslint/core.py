@@ -154,23 +154,42 @@ def git_changed_files(root: str) -> set[str] | None:
         if top.returncode != 0:
             return None
         toplevel = top.stdout.strip()
-        # --others is cwd-relative, diff is toplevel-relative: anchor
-        # each listing at the directory git resolves it against
-        for args, base in (
-                (["git", "diff", "--name-only", "HEAD"], toplevel),
-                (["git", "ls-files", "--others", "--exclude-standard"],
-                 root)):
-            res = subprocess.run(args, cwd=root, capture_output=True,
-                                 text=True, timeout=30)
-            if res.returncode != 0:
-                return None
-            for ln in res.stdout.splitlines():
-                ln = ln.strip()
-                if not ln:
-                    continue
-                rel = os.path.relpath(os.path.join(base, ln), root)
-                if not rel.startswith(".."):
-                    changed.add(rel.replace(os.sep, "/"))
+
+        def add(ln: str, base: str) -> None:
+            rel = os.path.relpath(os.path.join(base, ln), root)
+            if not rel.startswith(".."):
+                changed.add(rel.replace(os.sep, "/"))
+
+        # --name-status (with rename detection) instead of --name-only:
+        # a DELETED file must not reach the analyzer at all, and a
+        # RENAME must contribute only its NEW name — --name-only lists
+        # both sides, handing collect_files a path that no longer
+        # exists (and the per-file filter a key nothing matches)
+        diff = subprocess.run(["git", "diff", "--name-status", "-M",
+                               "HEAD"], cwd=root, capture_output=True,
+                              text=True, timeout=30)
+        if diff.returncode != 0:
+            return None
+        for ln in diff.stdout.splitlines():
+            parts = ln.rstrip().split("\t")
+            if len(parts) < 2 or not parts[0]:
+                continue
+            status = parts[0][0]
+            if status == "D":
+                continue                    # gone: nothing to lint
+            # R<score>/C<score> report "old<TAB>new": the surviving
+            # name is the last column either way
+            add(parts[-1], toplevel)
+        # untracked files are cwd-relative, not toplevel-relative
+        others = subprocess.run(["git", "ls-files", "--others",
+                                 "--exclude-standard"], cwd=root,
+                                capture_output=True, text=True,
+                                timeout=30)
+        if others.returncode != 0:
+            return None
+        for ln in others.stdout.splitlines():
+            if ln.strip():
+                add(ln.strip(), root)
     except (OSError, subprocess.SubprocessError):
         return None
     return changed
@@ -220,7 +239,8 @@ def run_lint(paths: Iterable[str], root: str | None = None,
     full set (cross-file consistency needs the whole picture even for
     an incremental run). Suppressions apply to both."""
     # load the checker modules so their @rule decorators run
-    from ceph_tpu.tools.radoslint import checkers, project  # noqa: F401
+    from ceph_tpu.tools.radoslint import (checkers, lifetimes,  # noqa: F401
+                                          project)
     root = os.path.abspath(root or os.getcwd())
     wanted = set(rules) if rules is not None else set(RULES)
     unknown = wanted - set(RULES)

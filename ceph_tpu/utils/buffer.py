@@ -13,7 +13,7 @@ import time
 
 import numpy as np
 
-from ceph_tpu.utils import copytrack
+from ceph_tpu.utils import copytrack, sanitizer
 
 
 class Ptr:
@@ -65,6 +65,10 @@ class BufferList:
     def append(self, data) -> "BufferList":
         """Append bytes/array/Ptr/BufferList. Arrays and Ptrs are shared
         zero-copy; bytes are copied once into a new segment."""
+        # numpy boundary: a sanitizer-guarded rx view unwraps here with
+        # its use-after-recycle check, then adopts reference-only like
+        # any other memoryview
+        data = sanitizer.unwrap(data)
         if isinstance(data, BufferList):
             self._ptrs.extend(data._ptrs)
             self._length += data._length

@@ -5,7 +5,25 @@ invariants over the AST; this module watches the same invariants on a
 LIVE event loop, the way the reference pairs lockdep (static ordering)
 with WITH_ASAN/WITH_TSAN builds (runtime). Enabled via the
 `sanitizer_enabled` config option (hot-togglable), it arms three probes
-on the daemon's loop:
+on the daemon's loop, plus the interlock concurrency probes:
+
+  * BUFFER GENERATION GUARDS — recycled buffers (offload staging
+    pages, frame rx bodies) register with a generation counter that
+    bumps at each recycle point; sanitizer mode wraps handed-out
+    memoryviews in `GuardedView`, so a use-after-recycle raises
+    `UseAfterRecycleError` AT THE ACCESS SITE instead of silently
+    reading the next batch's bytes (the runtime twin of radoslint's
+    `view-escape`/`view-across-await` rules);
+  * LOCKSET RECORDER — TSan-lite for cross-shard shared state:
+    `make_lock()` locks record per-thread locksets, and
+    `note_shared_access()` on shared-object fields reports any pair of
+    accesses from different threads with no common lock (at least one
+    a write) through `san_lockset_conflicts` (the runtime twin of
+    `shard-shared-mutation`);
+  * FOREIGN call_soon RECORDER — `loop.call_soon` driven from a thread
+    that doesn't own the loop is recorded (`san_foreign_call_soon`)
+    before asyncio's own debug-mode raise, so teardown-time strays that
+    swallow the RuntimeError still fail the conftest leak gate.
 
   * asyncio debug mode with a configurable slow-callback threshold —
     every callback that hogs the loop longer than
@@ -30,8 +48,10 @@ from __future__ import annotations
 import asyncio
 import logging
 import sys
+import threading
 import weakref
 
+from ceph_tpu.utils import loophook
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import PerfCountersCollection
 
@@ -68,6 +88,17 @@ def perf():
             pc.add("san_task_leaks",
                    description="tasks destroyed while still pending "
                                "(the messenger _dispatch_loop leak class)")
+            pc.add("san_view_guard_trips",
+                   description="guarded views accessed after their "
+                               "source buffer was recycled "
+                               "(use-after-recycle caught at the "
+                               "access site)")
+            pc.add("san_lockset_conflicts",
+                   description="cross-thread shared-state access pairs "
+                               "with no common lock (TSan-lite)")
+            pc.add("san_foreign_call_soon",
+                   description="loop.call_soon driven from a thread "
+                               "that does not own the loop")
         _perf = pc
     return _perf
 
@@ -139,13 +170,18 @@ def _exception_handler(loop, context: dict) -> None:
 
 
 def install(loop: asyncio.AbstractEventLoop | None = None,
-            slow_callback_s: float = DEFAULT_SLOW_CALLBACK_S) -> None:
+            slow_callback_s: float = DEFAULT_SLOW_CALLBACK_S,
+            view_guards: bool = True) -> None:
     """Arm the sanitizer on `loop` (default: the running loop).
-    Idempotent per loop; counters are process-wide."""
+    Idempotent per loop; counters, view guards, and the lockset
+    recorder are process-wide."""
     global _log_bridge
     if loop is None:
         loop = asyncio.get_running_loop()
     _tracked_loops.add(loop)
+    if view_guards:
+        set_view_guards(True)
+    set_lockset_recording(True)
     if loop in _installed_loops:
         loop.slow_callback_duration = float(slow_callback_s)
         return
@@ -153,6 +189,7 @@ def install(loop: asyncio.AbstractEventLoop | None = None,
     loop.slow_callback_duration = float(slow_callback_s)
     loop.set_task_factory(_task_factory)
     loop.set_exception_handler(_exception_handler)
+    _wrap_call_soon(loop)
     if _log_bridge is None:
         _log_bridge = _SlowCallbackBridge()
         logging.getLogger("asyncio").addHandler(_log_bridge)
@@ -170,7 +207,12 @@ def uninstall(loop: asyncio.AbstractEventLoop | None = None) -> None:
     loop.set_debug(False)
     loop.set_task_factory(None)
     loop.set_exception_handler(None)
+    _unwrap_call_soon(loop)
     _installed_loops.discard(loop)
+    if not len(_installed_loops):
+        # last armed loop gone: the process-wide probes disarm with it
+        set_view_guards(False)
+        set_lockset_recording(False)
 
 
 def register_config(config) -> None:
@@ -184,7 +226,11 @@ def register_config(config) -> None:
                 Option("sanitizer_slow_callback_s", "float",
                        DEFAULT_SLOW_CALLBACK_S,
                        "loop-stall threshold logged by the sanitizer",
-                       minimum=0.001)):
+                       minimum=0.001),
+                Option("sanitizer_view_guards", "bool", True,
+                       "wrap pooled-buffer views in generation guards "
+                       "while the sanitizer is armed (use-after-recycle "
+                       "raises at the access site)")):
         try:
             config.declare(opt)
         except ConfigError:
@@ -192,11 +238,15 @@ def register_config(config) -> None:
 
     def _apply(loop: asyncio.AbstractEventLoop, name: str, value) -> None:
         if name == "sanitizer_enabled":
-            install(loop, config.get("sanitizer_slow_callback_s")) \
+            install(loop, config.get("sanitizer_slow_callback_s"),
+                    view_guards=config.get("sanitizer_view_guards")) \
                 if value else uninstall(loop)
         elif name == "sanitizer_slow_callback_s" and \
                 loop in _installed_loops:
             loop.slow_callback_duration = float(value)
+        elif name == "sanitizer_view_guards" and \
+                loop in _installed_loops:
+            set_view_guards(bool(value))
 
     def _on_change(name: str, value) -> None:
         try:
@@ -209,8 +259,389 @@ def register_config(config) -> None:
                 if not loop.is_closed():
                     loop.call_soon_threadsafe(_apply, loop, name, value)
 
-    config.add_observer(("sanitizer_enabled", "sanitizer_slow_callback_s"),
-                        _on_change)
+    config.add_observer(("sanitizer_enabled", "sanitizer_slow_callback_s",
+                         "sanitizer_view_guards"), _on_change)
+
+
+# -- buffer generation guards -------------------------------------------------
+#
+# Recycled pools (offload staging pages, and — once a pooled rx path
+# lands — frame body buffers) register each buffer here; every recycle
+# point bumps the buffer's generation. `guard_view()` captures the
+# generation at hand-out, and every later access through the returned
+# GuardedView re-checks it: a view that outlived its buffer's recycle
+# raises at the access site, with the buffer label and both
+# generations, instead of reading whatever the pool's next tenant
+# wrote there.
+
+class UseAfterRecycleError(RuntimeError):
+    """A guarded view was accessed after its source buffer recycled."""
+
+
+class _Epoch:
+    """Generation cell for one tracked buffer (shared by the registry
+    and every GuardedView derived from the buffer)."""
+
+    __slots__ = ("gen", "label", "__weakref__")
+
+    def __init__(self, label: str):
+        self.gen = 0
+        self.label = label
+
+
+_epoch_lock = threading.Lock()
+_epochs: dict[int, _Epoch] = {}          # id(buffer) -> epoch
+#: non-weakrefable buffers (bytes) can't clean their entries via a
+#: finalizer; bound the registry instead (sanitizer mode only)
+_EPOCH_CAP = 8192
+_view_guards = False
+
+
+def view_guards_active() -> bool:
+    """True while sanitizer mode wraps pooled views in guards."""
+    return _view_guards
+
+
+def set_view_guards(enabled: bool) -> None:
+    global _view_guards
+    _view_guards = bool(enabled)
+
+
+def register_buffer(buf, label: str = "buffer") -> "_Epoch":
+    """Track `buf` (idempotent): returns its generation cell. ndarray/
+    bytearray entries self-clean via a finalizer; bytes (no weakref
+    support) entries are capped instead."""
+    key = id(buf)
+    with _epoch_lock:
+        ep = _epochs.get(key)
+        if ep is not None:
+            return ep
+        ep = _epochs[key] = _Epoch(label)
+        if len(_epochs) > _EPOCH_CAP:
+            # drop oldest insertions (dict preserves order); their
+            # guards degrade to unchecked, never to false trips
+            for stale in list(_epochs)[:_EPOCH_CAP // 4]:
+                del _epochs[stale]
+    try:
+        weakref.finalize(buf, _drop_epoch, key)
+    except TypeError:
+        pass                              # bytes: capped above
+    return ep
+
+
+def _drop_epoch(key: int) -> None:
+    with _epoch_lock:
+        _epochs.pop(key, None)
+
+
+def recycle_buffer(buf) -> None:
+    """Mark a recycle point: every view handed out against the
+    buffer's previous generation becomes stale (guards raise)."""
+    with _epoch_lock:
+        ep = _epochs.get(id(buf))
+    if ep is not None:
+        ep.gen += 1
+
+
+class GuardedView:
+    """Sanitizer-mode proxy over a memoryview tied to its source
+    buffer's generation. Implements the Python-level slice of the
+    memoryview API (len/index/slice/bytes/tobytes/iteration); slicing
+    yields guards sharing the ORIGINAL captured generation. `raw()` is
+    the checked unwrap for numpy/native boundaries (`np.frombuffer`
+    can't take a proxy) — the check there is the access-site check,
+    after it the bytes are read by C code regardless."""
+
+    __slots__ = ("_mv", "_epoch", "_gen")
+
+    def __init__(self, mv: memoryview, epoch: _Epoch, gen: int | None = None):
+        self._mv = mv
+        self._epoch = epoch
+        self._gen = epoch.gen if gen is None else gen
+
+    def _check(self) -> None:
+        if self._epoch.gen != self._gen:
+            perf().inc("san_view_guard_trips")
+            raise UseAfterRecycleError(
+                f"view over recycled {self._epoch.label} buffer: "
+                f"captured generation {self._gen}, buffer now at "
+                f"{self._epoch.gen} — the memory holds another "
+                f"batch's bytes")
+
+    # -- checked accessors ---------------------------------------------------
+
+    def raw(self) -> memoryview:
+        self._check()
+        return self._mv
+
+    def __len__(self) -> int:
+        self._check()
+        return len(self._mv)
+
+    @property
+    def nbytes(self) -> int:
+        self._check()
+        return self._mv.nbytes
+
+    @property
+    def obj(self):
+        self._check()
+        return self._mv.obj
+
+    def __getitem__(self, idx):
+        self._check()
+        if isinstance(idx, slice):
+            return GuardedView(self._mv[idx], self._epoch, self._gen)
+        return self._mv[idx]
+
+    def __bytes__(self) -> bytes:
+        self._check()
+        return bytes(self._mv)
+
+    def tobytes(self) -> bytes:
+        self._check()
+        return self._mv.tobytes()
+
+    def __iter__(self):
+        self._check()
+        return iter(self._mv)
+
+    def __eq__(self, other):
+        self._check()
+        if isinstance(other, GuardedView):
+            other._check()
+            other = other._mv
+        return self._mv == other
+
+    def __hash__(self):
+        self._check()
+        return hash(bytes(self._mv))
+
+    def __repr__(self) -> str:
+        state = "STALE" if self._epoch.gen != self._gen else "live"
+        return (f"<GuardedView {self._epoch.label} gen={self._gen} "
+                f"({state}) {len(self._mv)}B>")
+
+
+def guard_view(view, buf=None, label: str = "buffer"):
+    """Wrap `view` in a generation guard when guards are active.
+    `buf` is the tracked source buffer (default: the view's base
+    object). Non-memoryview values and disarmed mode pass through
+    unchanged, so call sites need no mode branching."""
+    if not _view_guards or not isinstance(view, memoryview):
+        return view
+    ep = register_buffer(view.obj if buf is None else buf, label)
+    return GuardedView(view, ep)
+
+
+def unwrap(data):
+    """Checked unwrap at numpy/native ingestion boundaries: a
+    GuardedView yields its raw memoryview (raising if stale); anything
+    else passes through untouched."""
+    if type(data) is GuardedView:
+        return data.raw()
+    return data
+
+
+# -- lockset recorder (TSan-lite) ---------------------------------------------
+#
+# Cross-shard shared state (the offload device topology, ShardPool
+# shared() services) is mutated from N reactor threads; the contract
+# is "every access under the owning lock". `make_lock()` hands out
+# locks that record per-thread locksets, and `note_shared_access()`
+# at a shared field's touch points compares this access against the
+# most recent access from every OTHER thread: different threads, no
+# common lock, at least one write -> one `san_lockset_conflicts`
+# increment plus a retained report. Recording is armed with the
+# sanitizer (or explicitly via set_lockset_recording) so the product
+# hot path pays one bool check when disarmed.
+
+_lockset_tls = threading.local()
+_lockset_on = False
+_conflict_lock = threading.Lock()
+_conflicts: list[dict] = []
+_CONFLICT_CAP = 256
+#: (id(owner), field) -> {thread_id: (lockset, is_write, site)}
+_shared_last: dict[tuple[int, str], dict[int, tuple]] = {}
+#: (id(owner), field, tid_a, tid_b) pairs already reported — one real
+#: race on a hot path must report ONCE, not once per access
+_reported_pairs: set[tuple] = set()
+
+
+def set_lockset_recording(enabled: bool) -> None:
+    global _lockset_on
+    _lockset_on = bool(enabled)
+    if not enabled:
+        with _conflict_lock:
+            _shared_last.clear()
+            _reported_pairs.clear()
+
+
+def lockset_recording() -> bool:
+    return _lockset_on
+
+
+class TrackedLock:
+    """threading.Lock wrapper that records itself in the holding
+    thread's lockset (always — the bookkeeping is two set ops; the
+    conflict analysis is what's gated). Locksets hold the lock OBJECT,
+    not its name: two same-named locks on different owners (every
+    _Topology is "offload_topology") must not alias, or a thread
+    holding the WRONG topology's lock would mask a real race."""
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+
+    def _held(self) -> set:
+        held = getattr(_lockset_tls, "held", None)
+        if held is None:
+            held = _lockset_tls.held = set()
+        return held
+
+    def acquire(self, *a, **kw) -> bool:
+        ok = self._lock.acquire(*a, **kw)
+        if ok:
+            self._held().add(self)
+        return ok
+
+    def release(self) -> None:
+        self._held().discard(self)
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def make_lock(name: str) -> TrackedLock:
+    """A lockset-recorded lock for cross-shard shared state."""
+    return TrackedLock(name)
+
+
+def held_locks() -> frozenset:
+    return frozenset(getattr(_lockset_tls, "held", ()) or ())
+
+
+def note_shared_access(owner, field: str, write: bool,
+                       site: str = "") -> None:
+    """Record one access to shared state; report a conflict when a
+    DIFFERENT thread last touched it with no common lock and either
+    access is a write."""
+    if not _lockset_on:
+        return
+    tid = threading.get_ident()
+    locks = held_locks()
+    key = (id(owner), field)
+    with _conflict_lock:
+        last = _shared_last.setdefault(key, {})
+        for other_tid, (other_locks, other_write, other_site) in \
+                last.items():
+            if other_tid == tid or not (write or other_write):
+                continue
+            if locks & other_locks:
+                continue
+            # dedup per (owner, field, thread pair): a conflicting
+            # access on a hot loop reports once, not once per access
+            pair = (id(owner), field, min(tid, other_tid),
+                    max(tid, other_tid))
+            if pair in _reported_pairs:
+                continue
+            _reported_pairs.add(pair)
+            perf().inc("san_lockset_conflicts")
+            names = sorted(lk.name for lk in locks)
+            other_names = sorted(lk.name for lk in other_locks)
+            report = {
+                "owner": type(owner).__name__, "field": field,
+                "a": {"thread": other_tid, "locks": other_names,
+                      "write": other_write, "site": other_site},
+                "b": {"thread": tid, "locks": names,
+                      "write": write, "site": site},
+            }
+            if len(_conflicts) < _CONFLICT_CAP:
+                _conflicts.append(report)
+            dout("san", 0,
+                 f"lockset conflict on {report['owner']}.{field}: "
+                 f"threads {other_tid}/{tid} share no lock "
+                 f"({other_names} vs {names})")
+        last[tid] = (locks, write, site)
+
+
+def lockset_conflicts() -> list[dict]:
+    with _conflict_lock:
+        return list(_conflicts)
+
+
+def clear_lockset_conflicts() -> None:
+    with _conflict_lock:
+        _conflicts.clear()
+        _shared_last.clear()
+        _reported_pairs.clear()
+
+
+# -- foreign-loop call_soon recorder ------------------------------------------
+
+_foreign_lock = threading.Lock()
+_foreign_call_soon: list[dict] = []
+_FOREIGN_CAP = 256
+
+
+def _record_foreign_call_soon(loop, cb) -> None:
+    perf().inc("san_foreign_call_soon")
+    code = getattr(cb, "__code__", None)
+    func = getattr(cb, "func", None)          # functools.partial
+    if code is None and func is not None:
+        code = getattr(func, "__code__", None)
+    site = (f"{code.co_filename}:{code.co_firstlineno}"
+            if code is not None else repr(cb))
+    with _foreign_lock:
+        if len(_foreign_call_soon) < _FOREIGN_CAP:
+            _foreign_call_soon.append({
+                "loop": repr(loop), "callback": site,
+                "thread": threading.get_ident()})
+    dout("san", 0, f"foreign-thread call_soon on {loop!r}: {site} — "
+                   f"use call_soon_threadsafe")
+
+
+def take_foreign_call_soon() -> list[dict]:
+    """Drain recorded foreign-thread call_soon events (the conftest
+    teardown gate consumes this after every test)."""
+    with _foreign_lock:
+        out = list(_foreign_call_soon)
+        _foreign_call_soon.clear()
+    return out
+
+
+def _wrap_call_soon(loop) -> None:
+    owner = threading.get_ident()
+
+    def make(orig):
+        def call_soon(callback, *args, **kwargs):
+            # armed-gate at CALL time: a buried wrapper can outlive
+            # uninstall (see utils/loophook) and must pass through
+            if loop in _installed_loops and \
+                    threading.get_ident() != owner:
+                # record BEFORE asyncio's debug-mode raise: a caller
+                # that swallows the RuntimeError still fails the
+                # teardown gate
+                _record_foreign_call_soon(loop, callback)
+            return orig(callback, *args, **kwargs)
+        return call_soon
+
+    loophook.wrap(loop, "san_call_soon", make)
+
+
+def _unwrap_call_soon(loop) -> None:
+    loophook.unwrap(loop, "san_call_soon")
 
 
 def maybe_install(config=None) -> None:
@@ -225,6 +656,7 @@ def maybe_install(config=None) -> None:
         # thread knows which loop(s) to arm
         _tracked_loops.add(asyncio.get_running_loop())
         if config.get("sanitizer_enabled"):
-            install(slow_callback_s=config.get("sanitizer_slow_callback_s"))
+            install(slow_callback_s=config.get("sanitizer_slow_callback_s"),
+                    view_guards=config.get("sanitizer_view_guards"))
     except Exception:
         pass                            # options not declared on this config

@@ -53,6 +53,14 @@ _leak_handler = _AsyncioLeakHandler()
 logging.getLogger("asyncio").addHandler(_leak_handler)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
+    config.addinivalue_line(
+        "markers",
+        "interleave: schedule-interleaving seed sweeps (the qa tier)")
+
+
 @pytest.fixture(autouse=True)
 def _no_pending_task_leaks():
     """Fail any test that destroys pending event-loop tasks.
@@ -82,3 +90,15 @@ def _no_pending_task_leaks():
         f"loop profiler still armed on {len(live)} loop(s) after the "
         f"test — loopprof.uninstall() (or profiler_enabled=false) "
         f"missing from teardown")
+    # foreign-loop call_soon gate: while the sanitizer was armed, any
+    # loop.call_soon driven from a thread that doesn't own the loop was
+    # recorded — teardown code that swallowed asyncio's debug-mode
+    # RuntimeError (or raced loop close) still fails HERE. Drained per
+    # test so a stray is attributed to the test that caused it.
+    from ceph_tpu.utils import sanitizer
+    strays = sanitizer.take_foreign_call_soon()
+    assert not strays, (
+        f"{len(strays)} foreign-thread call_soon event(s) recorded by "
+        f"the sanitizer — use call_soon_threadsafe (or run_on) to cross "
+        f"loops:\n" + "\n".join(
+            f"  {s['callback']} -> {s['loop']}" for s in strays[:10]))

@@ -1,0 +1,28 @@
+"""shard-shared-mutation negatives: every sanctioned mutation of
+shared() state — under the owning lock, or read-only access."""
+
+
+class Router:
+    def __init__(self, pool):
+        self._topo = pool.shared("offload_topology", dict)
+        # installing the lock itself is setup, not a race
+        self._topo.lock = None
+
+    def publish(self, pool, states):
+        topo = pool.shared("offload_topology", dict)
+        with topo.lock:
+            topo.states = states            # locked: the design
+            topo.mesh_fns.update({0: None})
+
+    def peek(self, pool):
+        topo = pool.shared("offload_topology", dict)
+        return topo.states                  # reads are the reader's risk
+
+    def nested(self):
+        with self._topo.lock:
+            if True:
+                self._topo.degraded = True  # still under the lock
+
+    def local_state(self, states):
+        topo = {}                           # NOT shared(): plain local
+        topo["states"] = states

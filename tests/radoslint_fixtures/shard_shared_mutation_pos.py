@@ -1,0 +1,18 @@
+"""shard-shared-mutation positives: unlocked writes to ShardPool
+shared() state (every reactor thread in the pool sees these)."""
+
+
+class Router:
+    def __init__(self, pool):
+        self._topo = pool.shared("offload_topology", dict)
+
+    def publish(self, pool, states):
+        topo = pool.shared("offload_topology", dict)
+        # BAD: torn publish — another shard reads half-written state
+        topo.states = states                              # finding 1
+        # BAD: dict mutation without the owning lock
+        topo.mesh_fns.update({0: None})                   # finding 2
+
+    def degrade(self):
+        # BAD: attribute-held shared object, same race
+        self._topo.degraded = True                        # finding 3

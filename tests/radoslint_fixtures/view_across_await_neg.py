@@ -1,0 +1,29 @@
+"""view-across-await negatives: handing a view INTO an awaited call,
+materializing before the suspension, or re-deriving after it."""
+import asyncio
+
+
+class Batcher:
+    async def dispatch(self, slot, conn):
+        page = slot.get_staging(4096)
+        # use INSIDE the awaited expression: the callee gets the bytes
+        # before this coroutine ever suspends
+        await conn.send(page)
+        return None
+
+    async def relay(self, frame, conn):
+        seg = frame.segments[2]
+        data = bytes(seg)               # materialized pre-await
+        await asyncio.sleep(0)
+        conn.push(data)
+
+    async def rederive(self, slot, conn):
+        page = slot.get_staging(4096)
+        await conn.flush()
+        page = slot.get_staging(4096)   # re-derived after the await
+        return page.nbytes
+
+    async def plain_view(self, blob):
+        mv = memoryview(blob)           # not a RECYCLED source: the
+        await asyncio.sleep(0)          # refcount pins plain buffers
+        return mv.nbytes

@@ -1,0 +1,18 @@
+"""view-across-await positives: a recycled-source view held across a
+suspension point (the await is exactly where another task recycles the
+buffer)."""
+import asyncio
+
+
+class Batcher:
+    async def dispatch(self, slot, conn):
+        page = slot.get_staging(4096)
+        await conn.send(b"hdr")
+        # BAD: `page` can be recycled while we were suspended
+        return bytes(page[0:8])                           # finding 1
+
+    async def relay(self, frame, conn):
+        seg = frame.segments[2]
+        await asyncio.sleep(0)
+        # BAD: frame segment used after the suspension point
+        conn.push(seg)                                    # finding 2

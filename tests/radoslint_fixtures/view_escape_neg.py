@@ -1,0 +1,30 @@
+"""view-escape negatives: every sanctioned way to handle a pooled
+view — materialize before storing, keep it local, or re-own it."""
+
+
+class Handler:
+    def __init__(self):
+        self.last_seg = None
+        self.pending = []
+        self.cache = {}
+
+    def on_frame(self, frame):
+        seg = frame.segments[0]
+        # materialized: the stored bytes own their memory
+        self.last_seg = bytes(seg)
+        self.pending.append(bytes(frame.segments[1]))
+        # local use inside the dispatch scope is the designed pattern
+        return len(seg)
+
+    def stage(self, slot):
+        page = slot.get_staging(4096)
+        view = page[0:1024]
+        self.cache["hot"] = view.tobytes()      # .tobytes() re-owns
+        out = {}
+        out["local"] = view     # local container: stays in scope
+        return bytes(view)      # materialized return
+
+    def rebound(self, frame):
+        seg = frame.segments[0]
+        seg = bytes(seg)        # rebinding to a clean value untracks
+        self.last_seg = seg

@@ -1,0 +1,32 @@
+"""view-escape positives: views over pooled/recycled buffers escaping
+their dispatch scope (each flagged line is a use-after-recycle waiting
+for the next batch/frame to rewrite the bytes)."""
+
+
+class Handler:
+    def __init__(self):
+        self.last_seg = None
+        self.pending = []
+        self.cache = {}
+
+    def on_frame(self, frame):
+        seg = frame.segments[0]
+        # BAD: a frame-segment view stored on self outlives the frame
+        self.last_seg = seg                               # finding 1
+        # BAD: container reachable through an attribute
+        self.pending.append(frame.segments[1])            # finding 2
+
+    def stage(self, slot):
+        page = slot.get_staging(4096)
+        view = page[0:1024]
+        # BAD: staging pages recycle on put_staging; the cache entry
+        # points into the NEXT batch's bytes
+        self.cache["hot"] = view                          # finding 3
+        # BAD: the caller gets a window onto a recycled pool
+        return view                                       # finding 4
+
+
+def window(blob):
+    mv = memoryview(blob)[4:]
+    # BAD: raw memoryview window returned past the deriving scope
+    return mv                                             # finding 5

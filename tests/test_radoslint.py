@@ -21,7 +21,8 @@ FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 ALL_RULES = {"detached-task", "blocking-in-coroutine", "await-under-lock",
              "cancellation-swallow", "loop-affinity",
              "registry-consistency", "decl-use",
-             "report-export-consistency"}
+             "report-export-consistency",
+             "view-escape", "view-across-await", "shard-shared-mutation"}
 
 
 def lint(path, rules):
@@ -50,6 +51,11 @@ def lint(path, rules):
      "decl_use_pipeline_good.py"),
     ("report-export-consistency", "report_export_bad.py", 1,
      "report_export_good.py"),
+    ("view-escape", "view_escape_pos.py", 5, "view_escape_neg.py"),
+    ("view-across-await", "view_across_await_pos.py", 2,
+     "view_across_await_neg.py"),
+    ("shard-shared-mutation", "shard_shared_mutation_pos.py", 3,
+     "shard_shared_mutation_neg.py"),
 ])
 def test_rule_fixtures(rule, pos, expected, neg):
     findings = lint(pos, rules=[rule])
@@ -227,6 +233,39 @@ def test_changed_only_restricts_file_rules(tmp_path):
     assert {f.path for f in findings} == {"mod.py"}
 
 
+def test_changed_only_handles_renames_and_deletes(tmp_path):
+    """`git diff` on a renamed file must contribute only the NEW name
+    and a deleted file nothing at all — the old --name-only parse
+    handed the analyzer paths that no longer exist, and a committed-
+    then-renamed finding escaped the incremental gate entirely."""
+    def git(*a):
+        subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                        *a], cwd=tmp_path, check=True, capture_output=True)
+    bad_src = ("import asyncio\n"
+               "async def f():\n"
+               "    asyncio.create_task(f())\n")
+    git("init", "-q")
+    (tmp_path / "old_name.py").write_text(bad_src)
+    (tmp_path / "doomed.py").write_text(bad_src)
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    # rename one bad file, delete the other — both via git so the diff
+    # reports R and D statuses
+    git("mv", "old_name.py", "new_name.py")
+    git("rm", "-q", "doomed.py")
+    findings = core.run_lint([str(tmp_path)], root=str(tmp_path),
+                             rules=["detached-task"], changed_only=True)
+    # the rename's new name is linted; the deleted path neither crashes
+    # the run nor appears in findings
+    assert {f.path for f in findings} == {"new_name.py"}
+    # worktree-only delete (no index involvement) is just as graceful
+    (tmp_path / "clean.py").unlink()
+    findings = core.run_lint([str(tmp_path)], root=str(tmp_path),
+                             rules=["detached-task"], changed_only=True)
+    assert {f.path for f in findings} == {"new_name.py"}
+
+
 # -- module entry point (the CI gate invocation) -----------------------------
 
 def test_module_entry_point_json():
@@ -347,6 +386,17 @@ def test_bench_trend_guard(tmp_path):
     t = trend_guard({"tpu_encode": 30.0, "tpu_decode": 36.0}, "tpu",
                     str(tmp_path))
     assert t is not None and t["baseline_round"] == "BENCH_r01.json"
+    # sanitizer-mode overhead is a COST key: a >10% RISE (the qa tier
+    # quietly getting pricier) warns like any throughput drop
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(
+        {"parsed": {"platform": "tpu",
+                    "detail": {"tpu_encode": 30.0,
+                               "interleave_sanitizer_overhead_pct": 20.0}}}))
+    t = trend_guard({"tpu_encode": 30.0,
+                     "interleave_sanitizer_overhead_pct": 25.0}, "tpu",
+                    str(tmp_path))
+    assert t["regression_pct"] == pytest.approx(25.0, abs=0.1)
+    assert "interleave_sanitizer_overhead_pct" in t["warning"]
 
 
 def test_bench_trend_guard_prefers_newest_round():
