@@ -45,11 +45,19 @@ try:
         os.environ.get("CEPH_TPU_REACTOR_SHARDS", "4")))
 except ValueError:
     REACTOR_SHARDS = 4
+# process-backed reactor knob: the cluster_tpu stage sweeps 1/2 worker
+# PROCESSES up to this cap (the true GIL escape; same guarded parse)
+try:
+    REACTOR_PROCS = max(1, int(
+        os.environ.get("CEPH_TPU_REACTOR_PROCS", "2")))
+except ValueError:
+    REACTOR_PROCS = 2
 CPU_TIMEOUT = 420
 DEVICE_TIMEOUT = 900  # single long warm: backend init + benches, one child
-CLUSTER_TPU_TIMEOUT = 620  # in-situ EC-over-tpu cluster stage: body
+CLUSTER_TPU_TIMEOUT = 860  # in-situ EC-over-tpu cluster stage: body
 #                            (240) + datapath (120) + reactor shard
-#                            curve (180) + scaling child headroom
+#                            curve (180) + process-backed curve (240)
+#                            + scaling child headroom
 ATTRIBUTION_TIMEOUT = 240  # hermetic attribution-profiler stage
 FAILURE_STORM_TIMEOUT = 320  # kill/revive resilience + repair-ratio stage
 SWARM_TIMEOUT = 320  # 200-client multi-tenant fairness + SLO pipeline stage
@@ -69,6 +77,7 @@ def _hermetic_env() -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["CEPH_TPU_REACTOR_SHARDS"] = str(REACTOR_SHARDS)
+    env["CEPH_TPU_REACTOR_PROCS"] = str(REACTOR_PROCS)
     return env
 
 
@@ -76,6 +85,7 @@ def _tpu_env() -> dict:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["CEPH_TPU_REACTOR_SHARDS"] = str(REACTOR_SHARDS)
+    env["CEPH_TPU_REACTOR_PROCS"] = str(REACTOR_PROCS)
     return env
 
 
@@ -258,6 +268,7 @@ def main() -> int:
         "baseline": baseline_name,
         "platform": device.get("platform", "none"),
         "reactor_shards": REACTOR_SHARDS,
+        "reactor_procs": REACTOR_PROCS,
         "detail": detail,
         "stages": {name: {k: s.get(k) for k in
                           ("status", "elapsed_s", "platform", "backend_init_s",

@@ -121,6 +121,26 @@ def _executor() -> concurrent.futures.ThreadPoolExecutor:
     return _pool
 
 
+def _device_partition() -> tuple[int, int] | None:
+    """(worker_ordinal, num_workers) from
+    CEPH_TPU_OFFLOAD_DEVICE_PARTITION ("j/W", set by the process-backed
+    reactor at worker spawn): each worker process serves a disjoint
+    round-robin slice of the visible chips, so per-chip XLA-compile and
+    pinned-bitmatrix warmth stays process-local instead of every worker
+    re-warming (and contending for) the full set."""
+    raw = os.environ.get("CEPH_TPU_OFFLOAD_DEVICE_PARTITION")
+    if not raw:
+        return None
+    try:
+        j, w = raw.split("/", 1)
+        j, w = int(j), int(w)
+    except ValueError:
+        return None
+    if w < 1 or j < 0:
+        return None
+    return j % w, w
+
+
 _perf_lock = threading.Lock()
 
 
@@ -289,6 +309,13 @@ class _Topology:
             devs = list(jax.devices())
         except Exception:
             devs = []
+        part = _device_partition()
+        if part is not None and devs:
+            # device-affine partition for a process-backed shard worker:
+            # slice FIRST (the partition defines this process's visible
+            # set), then let the count knob cap within it
+            j, w = part
+            devs = devs[j::w] or devs[:1]
         if device_count > 0:
             devs = devs[:device_count]
         for d in devs:
@@ -508,6 +535,13 @@ class OffloadService:
             from ceph_tpu.utils import reactor
             pool = reactor.pool_for(self._loop)
         except Exception:
+            pool = None
+        if pool is not None and \
+                getattr(pool, "backend", "thread") != "thread":
+            # process-backed shards share no memory: shared() is
+            # structurally absent there, and each worker process keeps
+            # its OWN topology over its partition of the chips (the
+            # parent's control loop likewise stays private)
             pool = None
         if self._topo_obj is None or pool is not self._topo_pool:
             self._topo_pool = pool

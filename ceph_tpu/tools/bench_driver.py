@@ -423,6 +423,20 @@ def _reactor_shards_knob(default: int = 4) -> int:
         return default
 
 
+#: process-backed reactor worker counts the cluster_tpu stage sweeps
+#: (capped by the CEPH_TPU_REACTOR_PROCS knob and the core count)
+REACTOR_PROC_COUNTS = (1, 2)
+
+
+def _reactor_procs_knob(default: int = 2) -> int:
+    """The bench's reactor_procs knob (CEPH_TPU_REACTOR_PROCS)."""
+    try:
+        return max(1, int(os.environ.get("CEPH_TPU_REACTOR_PROCS",
+                                         str(default))))
+    except ValueError:
+        return default
+
+
 def _mesh_scaling_body() -> dict:
     """Device-count scaling of the sharded stripe encode (the offload
     service's oversized-batch path): the SAME fixed workload timed over
@@ -879,6 +893,98 @@ def stage_cluster_tpu() -> dict:
             f"(speedup x{results['reactor_shard_speedup']}, "
             f"bit_identical={identical})")
 
+    async def procs_curve():
+        """Process-backed reactor scaling: the SAME offload-batched EC
+        write workload with the OSDs forked into 1/2 WORKER PROCESSES
+        (utils/reactor.py ProcShardPool — mon/client stay in this
+        process on shard 0). This is the true GIL escape the thread
+        curve could never show (1->2 threads measured 0.74x): each
+        worker runs its own interpreter, its own loop, its own offload
+        front end over its device partition, and the data path crosses
+        the process boundary over the messenger's existing sockets.
+        Capped at the core count like the shard curve; bit-identity is
+        checked by reading a known object back under every count. The
+        widest run arms the loop profiler in EVERY process (config
+        propagation over the control channel) and records the
+        cross-process shard_busy_skew the trend guard watches."""
+        from ceph_tpu import offload
+        from ceph_tpu.tools.cluster_boot import ephemeral_cluster
+        from ceph_tpu.tools.rados_bench import _phase
+        from ceph_tpu.utils import loopprof
+
+        max_procs = _reactor_procs_knob()
+        try:
+            cores = len(os.sched_getaffinity(0))
+        except (AttributeError, OSError):
+            cores = os.cpu_count() or 1
+        proc_counts = [n for n in REACTOR_PROC_COUNTS
+                       if n <= max_procs and n <= max(cores, 1)] or [1]
+        curve: dict[str, float] = {}
+        identical = True
+        payload = bytes(range(256)) * (OBJ // 256)
+        offload.set_enabled(True)
+        for n in proc_counts:
+            async with ephemeral_cluster(
+                    K8 + M3, prefix=f"bench-proc{n}-",
+                    reactor_procs=n) as (client, osds, _mon):
+                await client.command({
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "tpuprof",
+                    "profile": {"plugin": "tpu", "k": str(K8),
+                                "m": str(M3)}})
+                await client.pool_create("procbench", pg_num=8,
+                                         pool_type="erasure",
+                                         erasure_code_profile="tpuprof")
+                io = client.ioctx("procbench")
+                await asyncio.gather(*[io.write_full(f"warm-{i}", payload)
+                                       for i in range(4)])
+                pool = osds[0].pool
+                profiled = n == proc_counts[-1]
+                try:
+                    if profiled:
+                        loopprof.install()      # parent shard 0
+                        await pool.config_set("profiler_enabled", True)
+                    counts: dict = {}
+                    w = await _phase(io, "write", CONC, 2.5, OBJ,
+                                     counts)
+                    if profiled:
+                        prof = await pool.profile_stats()
+                        results["reactor_proc_per_shard"] = \
+                            prof["shards"]
+                        results["shard_busy_skew_procs"] = \
+                            prof["shard_busy_skew"]
+                finally:
+                    if profiled:
+                        # unarm even on a failed iteration: a sampler
+                        # left installed would tax every later stage
+                        try:
+                            await pool.config_set("profiler_enabled",
+                                                  False)
+                        except Exception:
+                            pass
+                        loopprof.uninstall()
+                curve[str(n)] = w["mb_per_s"]
+                got = await io.read("warm-0")
+                identical = identical and got == payload
+                log(f"reactor_procs={n}: write {w['mb_per_s']} MB/s "
+                    f"(bit_identical={got == payload})")
+        results["reactor_proc_scaling_mb_s"] = curve
+        results["reactor_proc_bit_identical"] = identical
+        results["reactor_procs"] = proc_counts[-1]
+        results["reactor_proc_cores"] = cores
+        base = curve.get("1") or 0.0
+        results["reactor_proc_speedup"] = round(
+            curve[str(proc_counts[-1])] / base, 3) if base else 0.0
+        # the guarded in-situ number: EC write MB/s with the widest
+        # process fan-out (acceptance: >= 1.15x the 1-proc figure on a
+        # 2-core box, where 2 THREADS measured 0.74x)
+        results["cluster_ec_write_mb_s_procs"] = \
+            curve[str(proc_counts[-1])]
+        log(f"reactor_proc_scaling: {curve} "
+            f"(speedup x{results['reactor_proc_speedup']}, "
+            f"skew={results.get('shard_busy_skew_procs')}, "
+            f"bit_identical={identical})")
+
     asyncio.run(asyncio.wait_for(body(), 240))
     asyncio.run(asyncio.wait_for(datapath(), 120))
     try:
@@ -889,6 +995,10 @@ def stage_cluster_tpu() -> dict:
         asyncio.run(asyncio.wait_for(shard_curve(), 180))
     except Exception as e:
         log(f"reactor_shard_scaling: FAILED {type(e).__name__}: {e}")
+    try:
+        asyncio.run(asyncio.wait_for(procs_curve(), 240))
+    except Exception as e:
+        log(f"reactor_proc_scaling: FAILED {type(e).__name__}: {e}")
     # device-count scaling curve of the mesh fan-out path (1/2/4/8)
     results.update(_device_scaling_curve())
     results["elapsed_s"] = round(_t.perf_counter() - t0, 1)
@@ -1671,7 +1781,8 @@ def stage_interleave() -> dict:
 
 TREND_KEYS = ("tpu_encode", "tpu_decode", "failure_storm_recovery_mb_s",
               "scaling_efficiency", "cluster_ec_write_mb_s",
-              "cluster_ec_tpu_write_mb_s_sharded", "swarm_mb_s",
+              "cluster_ec_tpu_write_mb_s_sharded",
+              "cluster_ec_write_mb_s_procs", "swarm_mb_s",
               "offload_mean_batch_ops")
 #: keys where UP is the regression direction: more copied bytes per
 #: written byte, a busier event loop, a slower recovery to clean, a
@@ -1683,6 +1794,7 @@ TREND_KEYS_COST = ("copy_amplification", "loop_busy_fraction",
                    "failure_storm_time_to_clean_s",
                    "failure_storm_repair_ratio",
                    "device_busy_skew", "shard_busy_skew",
+                   "shard_busy_skew_procs",
                    "swarm_p99_fairness", "python_us_per_op",
                    "msgr_frames_per_ec_write",
                    "pg_pipeline_stall_fraction",

@@ -16,6 +16,14 @@ with N > 1 the OSDs are placed round-robin across N event-loop shards
 control plane), each OSD's whole lifecycle (start, dispatch, stop)
 running on its owning shard. N = 1 is byte-for-byte the old single-loop
 boot: no pool, no threads.
+
+`reactor_procs` dials the PROCESS-backed runtime instead: N spawned
+worker processes (shards 1..N), OSDs placed round-robin into them and
+booted over the admin-socket control channel, while the mon and client
+stay in this process on shard 0. The yielded `osds` are
+`WorkerOSDRef` handles — daemon state lives in the workers, so the
+refs marshal everything (config, admin verbs, status) as JSON; there
+is no in-process OSD object to poke.
 """
 from __future__ import annotations
 
@@ -26,7 +34,43 @@ import tempfile
 from typing import AsyncIterator, Callable
 
 from ceph_tpu.utils.async_util import bounded_stop
-from ceph_tpu.utils.reactor import ShardPool
+from ceph_tpu.utils.reactor import ProcShardPool, ShardPool
+
+
+class WorkerOSDRef:
+    """Parent-side handle onto an OSD hosted by a shard worker process:
+    identity plus the JSON control-channel seams. Deliberately NOT an
+    OSD: cross-process state must be marshalled, never reached into."""
+
+    def __init__(self, pool: ProcShardPool, whoami: int, shard: int,
+                 addr: tuple[str, int]):
+        self.pool = pool
+        self.whoami = whoami
+        self.shard = shard
+        self.addr = addr
+
+    async def admin(self, request: dict | str, timeout: float = 30.0):
+        """One control-channel verb to this OSD's worker."""
+        return await self.pool.call(self.shard, request, timeout=timeout)
+
+    async def config_set(self, key: str, value) -> None:
+        """Set one option on THIS OSD only (whoami-routed — co-hosted
+        OSDs in the same worker keep their values, matching the
+        thread-mode `osd.config.set` semantics). Pool-wide broadcasts
+        go through `pool.config_set` instead. Recorded so a respawned
+        worker replays it onto this daemon's fresh boot."""
+        await self.admin({"prefix": "config set", "key": key,
+                          "value": value, "whoami": self.whoami})
+        self.pool.record_osd_override(self.whoami, key, value)
+
+    async def config_get(self, key: str):
+        res = await self.admin({"prefix": "config get", "key": key,
+                                "whoami": self.whoami})
+        return res[key]
+
+    async def status(self) -> dict:
+        st = await self.admin("worker status")
+        return st["osds"][str(self.whoami)]
 
 
 @contextlib.asynccontextmanager
@@ -34,16 +78,29 @@ async def ephemeral_cluster(
         n_osds: int, prefix: str = "ceph-tpu-",
         store_factory: Callable[[str, int], object] | None = None,
         stop_timeout: float = 20.0,
-        reactor_shards: int = 1) -> AsyncIterator[tuple]:
+        reactor_shards: int = 1,
+        reactor_procs: int = 0) -> AsyncIterator[tuple]:
     """Boot mon + `n_osds` OSDs on localhost and a connected client;
     yield `(client, osds, mon)`; reap everything on exit.
 
     `store_factory(tmpdir, osd_id)` supplies a per-OSD ObjectStore
     (None -> MemStore default). `reactor_shards` > 1 spreads the OSDs
-    over that many reactor shards (see module doc)."""
+    over that many reactor shards; `reactor_procs` > 0 spreads them
+    over that many worker PROCESSES instead (see module doc) — the two
+    modes are mutually exclusive, and a store_factory cannot cross a
+    process boundary."""
     from ceph_tpu.mon import MonMap, Monitor
     from ceph_tpu.osd.daemon import OSD
     from ceph_tpu.rados import RadosClient
+
+    if reactor_procs > 0:
+        if reactor_shards > 1:
+            raise ValueError("reactor_shards and reactor_procs are "
+                             "mutually exclusive")
+        if store_factory is not None:
+            raise ValueError("store_factory closures cannot cross the "
+                             "process boundary: process-backed OSDs "
+                             "build their own (MemStore) stores")
 
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -67,23 +124,40 @@ async def ephemeral_cluster(
     try:
         # inside the try: a pool that fails to come up must still tear
         # the already-running mon down
-        if reactor_shards > 1:
+        proc_pool = None
+        if reactor_procs > 0:
+            proc_pool = ProcShardPool(reactor_procs, base_dir=tmp)
+            await proc_pool.start()
+        elif reactor_shards > 1:
             pool = ShardPool(reactor_shards)
         while not (mon.paxos.is_leader() and mon.paxos.is_active()):
             await asyncio.sleep(0.05)
+        mon_addrs = list(monmap.mons.values())
         for i in range(n_osds):
+            if proc_pool is not None:
+                res = await proc_pool.boot_osd(i, mon_addrs)
+                osds.append(WorkerOSDRef(proc_pool, i, res["shard"],
+                                         tuple(res["addr"])))
+                continue
             store = store_factory(tmp, i) if store_factory else None
-            osd = OSD(i, list(monmap.mons.values()), store=store)
+            osd = OSD(i, mon_addrs, store=store)
             shard_of[i] = pool.place(i) if pool is not None else 0
             await _on_shard(i, osd.start())
             osds.append(osd)
-        client = RadosClient(list(monmap.mons.values()))
+        client = RadosClient(mon_addrs)
         await client.connect()
         yield client, osds, mon
     finally:
         if client is not None:
             await bounded_stop(client.shutdown(), stop_timeout)
+        if proc_pool is not None:
+            # the workers stop their own OSDs inside the shutdown verb
+            # (bounded, straggler-reaped), then the pool reaps the
+            # processes themselves
+            await proc_pool.shutdown(stop_timeout)
         for i, osd in enumerate(osds):
+            if isinstance(osd, WorkerOSDRef):
+                continue
             # stop each OSD ON its owning shard: its tasks, queues, and
             # connections are that loop's objects (loop-affinity rule)
             await _on_shard(i, bounded_stop(osd.stop(), stop_timeout))

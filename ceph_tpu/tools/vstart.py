@@ -45,7 +45,8 @@ class VCluster:
 
     def __init__(self, base_dir: str, n_mons: int = 1, n_osds: int = 3,
                  with_mgr: bool = False, with_mds: bool = False,
-                 with_rgw: bool = False, reactor_shards: int = 1):
+                 with_rgw: bool = False, reactor_shards: int = 1,
+                 reactor_procs: int = 0):
         ports = free_ports(n_mons)
         self.monmap = MonMap({f"m{i}": ("127.0.0.1", ports[i])
                               for i in range(n_mons)})
@@ -57,8 +58,16 @@ class VCluster:
         # sharded reactor: OSDs round-robin across N event-loop shards;
         # mons, mgr, mds, rgw, and clients stay on shard 0 (the calling
         # loop). 1 = the classic single-loop cluster, no pool at all.
+        # reactor_procs > 0 forks the shards into worker PROCESSES
+        # instead (`--procs`): OSDs boot over the admin-socket control
+        # channel and self.osds holds WorkerOSDRef handles, not OSDs.
         self.reactor_shards = max(1, int(reactor_shards))
+        self.reactor_procs = max(0, int(reactor_procs))
+        if self.reactor_procs and self.reactor_shards > 1:
+            raise ValueError("--shards and --procs are mutually "
+                             "exclusive")
         self.pool = None
+        self.proc_pool = None
         self._shard_of: dict[int, int] = {}
         self.mons: dict[str, Monitor] = {}
         self.osds: dict[int, OSD] = {}
@@ -72,7 +81,13 @@ class VCluster:
         return list(self.monmap.mons.values())
 
     async def start(self) -> None:
-        if self.reactor_shards > 1:
+        if self.reactor_procs:
+            from ceph_tpu.utils.reactor import ProcShardPool
+            self.proc_pool = ProcShardPool(self.reactor_procs,
+                                           name="vstart",
+                                           base_dir=self.base_dir)
+            await self.proc_pool.start()
+        elif self.reactor_shards > 1:
             from ceph_tpu.utils.reactor import ShardPool
             self.pool = ShardPool(self.reactor_shards, name="vstart")
         for name in self.monmap.mons:
@@ -110,7 +125,17 @@ class VCluster:
             self.rgw = RGWGateway(cl.ioctx(RGW_POOL))
             await self.rgw.start()
 
-    async def start_osd(self, i: int, store=None) -> OSD:
+    async def start_osd(self, i: int, store=None):
+        if self.proc_pool is not None:
+            if store is not None:
+                raise ValueError("a store object cannot cross the "
+                                 "process boundary")
+            from ceph_tpu.tools.cluster_boot import WorkerOSDRef
+            res = await self.proc_pool.boot_osd(i, self.mon_addrs)
+            ref = WorkerOSDRef(self.proc_pool, i, res["shard"],
+                               tuple(res["addr"]))
+            self.osds[i] = ref
+            return ref
         osd = OSD(i, self.mon_addrs, store=store)
         self.osds[i] = osd
         if self.pool is not None:
@@ -122,6 +147,9 @@ class VCluster:
 
     async def kill_osd(self, i: int) -> None:
         osd = self.osds.pop(i)
+        if self.proc_pool is not None:
+            await self.proc_pool.stop_osd(i)
+            return
         shard = self._shard_of.get(i)
         if self.pool is not None and shard is not None:
             await self.pool.run_on(shard, osd.stop())
@@ -145,6 +173,11 @@ class VCluster:
                 await bounded_stop(daemon.stop(), 20)
         for c in self.clients:
             await bounded_stop(c.shutdown(), 20)
+        if self.proc_pool is not None:
+            # workers stop their own OSDs inside the shutdown verb
+            await self.proc_pool.shutdown()
+            self.proc_pool = None
+            self.osds.clear()
         for i, osd in list(self.osds.items()):
             shard = self._shard_of.get(i)
             if self.pool is not None and shard is not None:
@@ -171,7 +204,9 @@ class VCluster:
                      for name, m in self.mons.items()},
             "osdmap_epoch": osdmap.epoch if osdmap else 0,
             "osds": {i: {"up": bool(osdmap and osdmap.is_up(i)),
-                         "pgs": len(o.pgs)}
+                         # WorkerOSDRef: PG state lives in the worker
+                         # process — fetch via `worker status` instead
+                         "pgs": len(getattr(o, "pgs", ()))}
                      for i, o in self.osds.items()},
             "pools": ({p.name: {"type": p.type, "size": p.size,
                                 "pg_num": p.pg_num}
@@ -179,12 +214,13 @@ class VCluster:
         }
 
 
-async def smoke(n_mons: int, n_osds: int, shards: int = 1) -> dict:
+async def smoke(n_mons: int, n_osds: int, shards: int = 1,
+                procs: int = 0) -> dict:
     """Boot, write/read through a replicated pool, report. Exit-code
     contract: raises on any failure, returns the status dict on success."""
     with tempfile.TemporaryDirectory(prefix="vstart-") as base:
         c = VCluster(base, n_mons=n_mons, n_osds=n_osds,
-                     reactor_shards=shards)
+                     reactor_shards=shards, reactor_procs=procs)
         try:
             await c.start()
             cl = await c.client()
@@ -234,12 +270,16 @@ def main() -> int:
     p.add_argument("--shards", type=int, default=1,
                    help="reactor shards: OSDs round-robin across N "
                         "event-loop threads (1 = single loop)")
+    p.add_argument("--procs", type=int, default=0,
+                   help="process-backed reactor: OSDs round-robin "
+                        "across N spawned worker processes (true GIL "
+                        "escape; 0 = in-process runtime)")
     args = p.parse_args()
     if not args.smoke:
         p.error("only --smoke mode is supported (in-process daemons "
                 "cannot outlive the interpreter)")
     status = asyncio.run(asyncio.wait_for(
-        smoke(args.mons, args.osds, args.shards), 120))
+        smoke(args.mons, args.osds, args.shards, args.procs), 120))
     print(json.dumps(status, indent=1))
     return 0
 

@@ -261,6 +261,29 @@ def shard_stats() -> dict[str, dict]:
         for label, d in sorted(per.items())}
 
 
+def merge_shard_stats(*parts: dict[str, dict]) -> dict[str, dict]:
+    """Merge per-process `shard_stats()` snapshots into one pool-wide
+    view, keyed by shard label. Under the process-backed reactor each
+    worker samples its OWN loop and labels it with the pool-wide shard
+    index (`reactor.adopt_worker_shard`), so the parent can fetch every
+    worker's stats over the control channel and hand the union to
+    `shard_busy_skew` — the cross-process number the bench trend guard
+    watches. Same-label snapshots (a respawned worker's fresh process)
+    sum counters and recompute the fraction."""
+    merged: dict[str, dict] = {}
+    for part in parts:
+        for label, d in (part or {}).items():
+            m = merged.setdefault(label, {"samples": 0, "busy_samples": 0})
+            m["samples"] += int(d.get("samples", 0))
+            m["busy_samples"] += int(d.get("busy_samples", 0))
+    return {label: {
+        "samples": m["samples"],
+        "busy_samples": m["busy_samples"],
+        "loop_busy_fraction": round(m["busy_samples"] / m["samples"], 4)
+        if m["samples"] else 0.0}
+        for label, m in sorted(merged.items())}
+
+
 def shard_busy_skew(shards: dict[str, dict] | None = None) -> float:
     """(max-min)/max busy fraction across sampled shards: 0 = balanced
     load, 1 = one shard saturated while another idles. The trend guard

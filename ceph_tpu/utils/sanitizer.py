@@ -463,6 +463,9 @@ _conflicts: list[dict] = []
 _CONFLICT_CAP = 256
 #: (id(owner), field) -> {thread_id: (lockset, is_write, site)}
 _shared_last: dict[tuple[int, str], dict[int, tuple]] = {}
+#: (id(owner), field) -> weakref to the owner the records describe —
+#: the id-reuse guard (see note_shared_access)
+_shared_owner_refs: dict[tuple[int, str], object] = {}
 #: (id(owner), field, tid_a, tid_b) pairs already reported — one real
 #: race on a hot path must report ONCE, not once per access
 _reported_pairs: set[tuple] = set()
@@ -471,10 +474,13 @@ _reported_pairs: set[tuple] = set()
 def set_lockset_recording(enabled: bool) -> None:
     global _lockset_on
     _lockset_on = bool(enabled)
-    if not enabled:
-        with _conflict_lock:
-            _shared_last.clear()
-            _reported_pairs.clear()
+    # clear on ARM as well as disarm: access records are keyed by
+    # id(owner), and a freed owner's id gets recycled — records from a
+    # previous recording window must never alias onto a new object
+    with _conflict_lock:
+        _shared_last.clear()
+        _shared_owner_refs.clear()
+        _reported_pairs.clear()
 
 
 def lockset_recording() -> bool:
@@ -544,16 +550,47 @@ def note_shared_access(owner, field: str, write: bool,
     key = (id(owner), field)
     with _conflict_lock:
         last = _shared_last.setdefault(key, {})
+        # id-reuse guard WITHIN a recording window: if the key's
+        # records belong to a freed object whose id was recycled onto
+        # `owner`, comparing against them manufactures conflicts
+        # between unrelated objects (their same-NAMED locks are
+        # different identities). The weakref pins which object the
+        # records describe; a mismatch restarts the key fresh.
+        ref = _shared_owner_refs.get(key)
+        if ref is None or ref() is not owner:
+            if ref is not None:
+                last.clear()
+                # the recycled id's reported-pair dedup entries must go
+                # too, or a REAL race on the new object between the
+                # same two thread ids is silently deduped away
+                for pair in [p for p in _reported_pairs
+                             if p[0] == key[0] and p[1] == field]:
+                    _reported_pairs.discard(pair)
+            try:
+                _shared_owner_refs[key] = weakref.ref(owner)
+            except TypeError:
+                # unweakrefable owner (__slots__ without __weakref__):
+                # no identity guard possible — recycled-id aliasing
+                # stays latent for such owners (none exist in-tree;
+                # clearing per access would kill detection outright)
+                _shared_owner_refs.pop(key, None)
         for other_tid, (other_locks, other_write, other_site) in \
                 last.items():
             if other_tid == tid or not (write or other_write):
                 continue
             if locks & other_locks:
                 continue
-            # dedup per (owner, field, thread pair): a conflicting
-            # access on a hot loop reports once, not once per access
-            pair = (id(owner), field, min(tid, other_tid),
-                    max(tid, other_tid))
+            # dedup per (owner, field, LOCKSET pair): the same
+            # conflicting access pattern on a hot loop reports once,
+            # not once per access. Keyed by the lock-identity sets —
+            # NOT thread idents: a joined thread's ident is only
+            # sometimes recycled onto its successor, so tid-keyed
+            # dedup held or failed at the OS's whim (the
+            # test_interleave lockset flake), while the lockset pair
+            # is what actually names the racing pattern.
+            pair = (id(owner), field,
+                    frozenset((frozenset(locks),
+                               frozenset(other_locks))))
             if pair in _reported_pairs:
                 continue
             _reported_pairs.add(pair)

@@ -1,0 +1,34 @@
+"""proc-shared-state negatives: explicit marshalling over the control
+channel, and thread-backed pools (where shared()/run_on are the
+design, guarded by shard-shared-mutation/loop-affinity instead)."""
+from ceph_tpu.utils.reactor import ProcShardPool, ShardPool
+
+
+class Service:
+    def __init__(self):
+        self._pool = ProcShardPool(2)
+
+    async def marshal(self):
+        # the sanctioned seams: JSON over the admin-socket channel
+        await self._pool.call(1, {"prefix": "config set",
+                                  "key": "osd_pg_pipeline_depth",
+                                  "value": 2})
+        await self._pool.config_set("profiler_enabled", True)
+        await self._pool.boot_osd(3, [("127.0.0.1", 6789)])
+
+    async def reads_are_fine(self):
+        # reading pool identity/liveness is parent-local by nature
+        if self._pool.worker_alive(1):
+            return self._pool.num_shards
+        return 0
+
+
+class ThreadWorld:
+    async def thread_pool_conveniences(self, osd):
+        # a THREAD-backed pool: shared()/run_on are the design there
+        pool = ShardPool(2)
+        topo = pool.shared("topo", dict)
+        with topo.lock:
+            topo.states = 1
+        await pool.run_on(1, osd.stop())
+        await pool.shutdown()

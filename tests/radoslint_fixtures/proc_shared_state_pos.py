@@ -1,0 +1,26 @@
+"""proc-shared-state positives: thread-pool conveniences reaching into
+a process-backed reactor pool (cross-process memory doesn't exist)."""
+from ceph_tpu.utils.reactor import ProcShardPool
+
+
+class Service:
+    def __init__(self):
+        self._pool = ProcShardPool(2)
+        self._topo = self._pool.shared("topo", dict)
+
+    def publish(self, states):
+        # BAD: parent-local orphan — no worker process ever sees it
+        self._topo.states = states                        # finding 1
+        # BAD: mutator call, same orphaned-state race
+        self._topo.update({"mesh": None})                 # finding 2
+
+    def inline(self):
+        pool = ProcShardPool(4)
+        # BAD: inline mutation of a proc-pool shared() result
+        pool.shared("cache", dict)["key"] = 1             # finding 3
+
+    async def fanout(self, osd):
+        pool = ProcShardPool(2)
+        # BAD: the coroutine's closure captures parent state (osd) —
+        # it cannot cross the interpreter boundary
+        await pool.run_on(1, osd.stop())                  # finding 4

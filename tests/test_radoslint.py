@@ -22,7 +22,8 @@ ALL_RULES = {"detached-task", "blocking-in-coroutine", "await-under-lock",
              "cancellation-swallow", "loop-affinity",
              "registry-consistency", "decl-use",
              "report-export-consistency",
-             "view-escape", "view-across-await", "shard-shared-mutation"}
+             "view-escape", "view-across-await", "shard-shared-mutation",
+             "proc-shared-state"}
 
 
 def lint(path, rules):
@@ -56,6 +57,8 @@ def lint(path, rules):
      "view_across_await_neg.py"),
     ("shard-shared-mutation", "shard_shared_mutation_pos.py", 3,
      "shard_shared_mutation_neg.py"),
+    ("proc-shared-state", "proc_shared_state_pos.py", 4,
+     "proc_shared_state_neg.py"),
 ])
 def test_rule_fixtures(rule, pos, expected, neg):
     findings = lint(pos, rules=[rule])

@@ -1,0 +1,252 @@
+"""Process-backed reactor runtime tests: worker spawn/supervise/reap,
+the admin-socket control channel (boot/config/inject verbs), a
+process-backed cluster round-trip bit-identical to the single-loop
+runtime, the SIGKILL -> supervisor-reap -> reporter-quorum-mark-down ->
+respawn-rejoin drill, cross-process loopprof attribution keyed by
+pool-wide shard index, mechanical rejection of the thread-pool
+conveniences (shared()/run_on), and the GIL switch-interval rule
+(process pools never install the 0.5 ms override; mixed-mode teardown
+restores correctly). Every test runs under the conftest pending-task
+leak gate, so a parent-side supervisor/executor leak fails loudly."""
+import asyncio
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.utils import reactor
+from ceph_tpu.utils.reactor import ProcShardPool, ShardPool
+
+
+def run(coro, timeout=180):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# ---------------------------------------------------------------------------
+# pool identity + rejected conveniences + switch interval
+# ---------------------------------------------------------------------------
+
+def test_proc_pool_identity_and_rejected_conveniences():
+    async def body():
+        default_interval = sys.getswitchinterval()
+        pool = ProcShardPool(2, name="t-ident")
+        try:
+            await pool.start()
+            assert pool.num_shards == 3
+            # OSDs round-robin over WORKERS only; shard 0 = this loop
+            assert [pool.place(i) for i in range(5)] == [1, 2, 1, 2, 1]
+            assert pool.loop(0) is asyncio.get_running_loop()
+            assert reactor.pool_for(asyncio.get_running_loop()) is pool
+            assert reactor.shard_index_of(asyncio.get_running_loop()) == 0
+            with pytest.raises(NotImplementedError):
+                pool.loop(1)        # another process's loop: unaddressable
+            st = await pool.call(1, "worker status")
+            assert st["shard"] == 1 and st["pid"] != 0
+            assert st["pid"] == pool.worker_pid(1)
+            # thread-pool conveniences are rejected MECHANICALLY:
+            # cross-process memory doesn't exist, coroutines can't ship
+            with pytest.raises(NotImplementedError, match="cross-process"):
+                pool.shared("topo", dict)
+
+            async def c():
+                pass
+            with pytest.raises(NotImplementedError, match="process "
+                                                          "boundary"):
+                await pool.run_on(1, c())
+            # a pool-wide broadcast onto (momentarily) OSD-less workers
+            # is a no-op, not a half-propagated abort
+            out = await pool.config_set("osd_heartbeat_grace", 2.0)
+            assert all(r["applied"] == [] for r in out.values())
+            # a process pool never installs the 0.5 ms GIL override:
+            # its shards don't share an interpreter, so the override
+            # would be a pure context-switch tax on the parent
+            assert sys.getswitchinterval() == default_interval
+            # mixed mode: a concurrently-live THREAD pool still gets
+            # (and refcounts) the override; its teardown restores while
+            # the process pool stays up
+            tpool = ShardPool(2, name="t-mixed")
+            try:
+                assert sys.getswitchinterval() == \
+                    ShardPool.SWITCH_INTERVAL_S
+                # the nested thread pool owns shard 0 while live...
+                assert reactor.pool_for(
+                    asyncio.get_running_loop()) is tpool
+            finally:
+                await tpool.shutdown()
+            assert sys.getswitchinterval() == default_interval
+            # ...and its teardown RESTORES the outer proc pool's
+            # registration instead of erasing it (registry stack)
+            assert reactor.pool_for(asyncio.get_running_loop()) is pool
+            assert reactor.shard_index_of(
+                asyncio.get_running_loop()) == 0
+        finally:
+            await pool.shutdown()
+        assert sys.getswitchinterval() == default_interval
+        # every worker exited through the graceful shutdown verb
+        assert all(not pool.worker_alive(i) for i in (1, 2))
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# process-backed cluster: op round-trip bit-identity vs the single loop
+# ---------------------------------------------------------------------------
+
+def _cluster_roundtrip(procs: int):
+    async def body():
+        from ceph_tpu.tools.cluster_boot import ephemeral_cluster
+        payloads = {f"o{i}": bytes([i + 1]) * 9000 for i in range(6)}
+        got = {}
+        workers = []
+        async with ephemeral_cluster(
+                3, prefix=f"procrt{procs}-",
+                reactor_procs=procs) as (client, osds, _mon):
+            await client.command({
+                "prefix": "osd erasure-code-profile set",
+                "name": "rtprof",
+                "profile": {"plugin": "jerasure", "k": "2", "m": "1",
+                            "technique": "reed_sol_van"}})
+            await client.pool_create("rt", pg_num=4,
+                                     pool_type="erasure",
+                                     erasure_code_profile="rtprof")
+            io = client.ioctx("rt")
+            for oid, data in payloads.items():
+                await io.write_full(oid, data)
+            for oid in payloads:
+                got[oid] = await io.read(oid)
+            if procs > 0:
+                pool = osds[0].pool
+                workers = [pool._worker(i) for i in (1, 2)]
+                # daemons really forked: distinct worker pids, both
+                # workers host OSDs, and daemon status reports the
+                # POOL-WIDE shard index over the control channel
+                assert {o.shard for o in osds} == {1, 2}
+                pids = {(await pool.call(i, "worker status"))["pid"]
+                        for i in (1, 2)}
+                assert len(pids) == 2
+                st = await osds[0].status()
+                assert st["reactor_shard"] == osds[0].shard
+                # per-OSD knob routing: osd.0 and osd.2 share worker
+                # shard1, and the handle's config_set must touch ONLY
+                # its own daemon (thread-mode semantics)
+                await osds[0].config_set("osd_pg_pipeline_depth", 2)
+                assert await osds[0].config_get(
+                    "osd_pg_pipeline_depth") == 2
+                assert await osds[2].config_get(
+                    "osd_pg_pipeline_depth") == 4
+                # pool-wide broadcast reaches every hosted OSD
+                await pool.config_set("osd_pg_pipeline_depth", 3)
+                assert await osds[2].config_get(
+                    "osd_pg_pipeline_depth") == 3
+        if procs > 0:
+            # teardown drained the workers: graceful exit (straggler
+            # reap inside the worker ran), not a kill
+            assert all(w.proc.returncode == 0 for w in workers)
+        return payloads, got
+    return run(body(), timeout=180)
+
+
+def test_proc_cluster_roundtrip_bit_identical_vs_single_loop():
+    p1, g1 = _cluster_roundtrip(0)
+    p2, g2 = _cluster_roundtrip(2)
+    assert g1 == p1                 # single-loop ground truth
+    assert g2 == p2                 # process-backed runtime: same bytes
+    assert g1 == g2                 # and identical across runtimes
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL drill: crash verb -> supervisor reap -> mark-down -> respawn
+# ---------------------------------------------------------------------------
+
+def test_worker_crash_reap_markdown_respawn():
+    """The dead-shard-host drill end to end: the faultinject `crash`
+    verb SIGKILLs a worker (no teardown, no goodbyes), the parent
+    supervisor reaps the corpse, the worker's OSDs get marked down by
+    the EXISTING reporter-quorum path (surviving peers stop hearing
+    heartbeats), and a fresh respawn re-boots the same OSD ids, which
+    rejoin and serve I/O."""
+    async def body():
+        from ceph_tpu.tools.cluster_boot import ephemeral_cluster
+        # 4 OSDs over 2 workers: killing shard2 (osd.1 + osd.3) leaves
+        # two reporters (osd.0, osd.2) — the mon's reporter quorum
+        async with ephemeral_cluster(
+                4, prefix="prockill-",
+                reactor_procs=2) as (client, osds, mon):
+            pool = osds[0].pool
+            await client.pool_create("rp", pg_num=8, size=3)
+            io = client.ioctx("rp")
+            for i in range(6):
+                await io.write_full(f"o{i}", b"x" * 4096)
+            # config propagation tightens the drill: the grace knob
+            # reaches the SURVIVING workers' observers live
+            await pool.config_set("osd_heartbeat_grace", 1.0)
+            await pool.config_set("osd_heartbeat_interval", 0.25)
+            t0 = time.monotonic()
+            r = await pool.inject_crash(2)
+            assert r["injected"] == "crash" and r["shard"] == 2
+            while pool.worker_alive(2):
+                assert time.monotonic() - t0 < 15, \
+                    "supervisor never reaped the killed worker"
+                await asyncio.sleep(0.1)
+            # reaped for real: no zombie left behind
+            assert pool._worker(2).proc.returncode is not None
+            omap = mon.osdmon.osdmap
+            while omap.is_up(1) or omap.is_up(3):
+                assert time.monotonic() - t0 < 60, \
+                    "killed worker's OSDs never marked down"
+                await asyncio.sleep(0.2)
+            rr = await pool.respawn(2)
+            assert {o["whoami"] for o in rr["osds"]} == {1, 3}
+            # the fresh process rejoined with the operator's hot knobs
+            # REPLAYED, not the defaults — peers run grace 1.0, and a
+            # respawn that silently reverted would diverge the cluster
+            g = await pool.call(2, {"prefix": "config get",
+                                    "key": "osd_heartbeat_grace"})
+            assert g["osd_heartbeat_grace"] == 1.0
+            while not (omap.is_up(1) and omap.is_up(3)):
+                assert time.monotonic() - t0 < 120, \
+                    "respawned worker's OSDs never rejoined"
+                await asyncio.sleep(0.2)
+            # the rejoined cluster serves I/O
+            await io.write_full("post", b"y" * 4096)
+            assert await io.read("post") == b"y" * 4096
+    run(body(), timeout=240)
+
+
+# ---------------------------------------------------------------------------
+# cross-process loopprof attribution (pool-wide shard labels + skew)
+# ---------------------------------------------------------------------------
+
+def test_cross_process_profile_stats_use_pool_wide_shard_labels():
+    """Each worker samples its own loop but labels it with the
+    POOL-WIDE shard index (reactor.adopt_worker_shard), so the parent's
+    merge is keyed shard0/shard1/shard2 — not three pid-local 'loop0's
+    — and the cross-process busy skew is computable."""
+    async def body():
+        from ceph_tpu.tools.cluster_boot import ephemeral_cluster
+        from ceph_tpu.utils import loopprof
+        async with ephemeral_cluster(
+                2, prefix="procprof-",
+                reactor_procs=2) as (client, osds, _mon):
+            pool = osds[0].pool
+            loopprof.install()              # parent shard 0
+            try:
+                await pool.config_set("profiler_enabled", True)
+                await client.pool_create("p", pg_num=4, size=2)
+                io = client.ioctx("p")
+                for i in range(8):
+                    await io.write_full(f"o{i}", b"z" * 8192)
+                await asyncio.sleep(0.3)    # sampler ticks everywhere
+                prof = await pool.profile_stats()
+                shards = prof["shards"]
+                assert {"shard0", "shard1", "shard2"} <= set(shards)
+                assert all(d["samples"] > 0 for d in shards.values())
+                assert 0.0 <= prof["shard_busy_skew"] <= 1.0
+                # merge helper: same-label parts sum, fractions recompute
+                merged = loopprof.merge_shard_stats(
+                    {"shard1": {"samples": 10, "busy_samples": 5}},
+                    {"shard1": {"samples": 10, "busy_samples": 0}})
+                assert merged["shard1"]["loop_busy_fraction"] == 0.25
+                await pool.config_set("profiler_enabled", False)
+            finally:
+                loopprof.uninstall()
+    run(body(), timeout=180)
