@@ -59,9 +59,12 @@ CLUSTER_TPU_TIMEOUT = 860  # in-situ EC-over-tpu cluster stage: body
 #                            curve (180) + process-backed curve (240)
 #                            + scaling child headroom
 ATTRIBUTION_TIMEOUT = 240  # hermetic attribution-profiler stage
-FAILURE_STORM_TIMEOUT = 320  # kill/revive resilience + repair-ratio stage
+FAILURE_STORM_TIMEOUT = 500  # kill/revive resilience + repair-ratio stage
+#                              (280) + cross-process flight-recorder
+#                              drill (170) + headroom
 SWARM_TIMEOUT = 320  # 200-client multi-tenant fairness + SLO pipeline stage
-INTERLEAVE_TIMEOUT = 300  # seed-swept schedule explorer + sanitizer overhead
+INTERLEAVE_TIMEOUT = 440  # seed-swept schedule explorer + sanitizer AND
+#                           flight-recorder overhead (3 modes x 2 reps)
 METRIC = "ec_encode_k8m3_1MiB_chunk"
 
 _deadline = time.monotonic() + TOTAL_BUDGET

@@ -36,10 +36,13 @@ import time
 
 from ceph_tpu.crush.osdmap import Incremental, OSDMap, PG
 from ceph_tpu.mgr.exporter import MetricsExporter
+from ceph_tpu.mgr.history import MetricsHistory
+from ceph_tpu.mgr.history import bucket_quantile_ms as _bucket_quantile_ms
 from ceph_tpu.mon.mon_client import MonClient
 from ceph_tpu.msg.messages import (Message, MMgrConfigure, MMgrOpen,
                                    MMgrReport)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
+from ceph_tpu.utils import flight
 from ceph_tpu.utils.dout import dout
 
 import json
@@ -70,22 +73,10 @@ class DaemonState:
         return time.monotonic() - self.last_report_mono
 
 
-def _bucket_quantile_ms(buckets: dict[int, int], q: float) -> float:
-    """Quantile upper bound (ms) from power-of-two µs buckets: the
-    smallest bucket bound below which >= q of the samples fall. Bucket
-    exp i counts latencies in [2^i, 2^(i+1)) µs, so the bound quoted
-    is 2^(i+1) µs — the same `le` edge the exporter's cumulative
-    histograms use."""
-    total = sum(buckets.values())
-    if not total:
-        return 0.0
-    want = q * total
-    cum = 0
-    for exp in sorted(buckets):
-        cum += buckets[exp]
-        if cum >= want:
-            return round(2 ** (exp + 1) / 1e3, 3)
-    return round(2 ** (max(buckets) + 1) / 1e3, 3)
+# the ONE bucketing rule lives in mgr/history.py (bucket_quantile_ms),
+# imported above under this module's historical name — the client
+# aggregate, the digest, and the history window math must all quote
+# the same 2^(i+1) µs upper edge
 
 
 class DaemonStateIndex:
@@ -94,11 +85,27 @@ class DaemonStateIndex:
     are culled so a dead daemon's metrics never linger in /metrics)."""
 
     STALE_AFTER = 8.0           # seconds without a report before eviction
+    #: distinct (pid, boot) flight rings retained; each bounded below.
+    #: Rings are NOT culled with their daemon — a post-mortem wants
+    #: exactly the events of daemons that stopped reporting — they
+    #: rotate out oldest-update-first past this cap.
+    MAX_FLIGHT_SOURCES = 64
+    #: per-source retained events (>= any daemon's default ring so a
+    #: full ring resend survives intact)
+    FLIGHT_SOURCE_EVENTS = 1024
 
     def __init__(self, stale_after: float | None = None):
         self.stale_after = stale_after if stale_after is not None \
             else self.STALE_AFTER
         self.daemons: dict[str, DaemonState] = {}
+        # time-resolved sample rings per (daemon, metric), fed from
+        # report() at the history cadence
+        self.history = MetricsHistory()
+        # flight-recorder fan-in: {(pid, boot): {"events": [...],
+        # "mono_now", "wall_now", "max_seq", "updated_mono"}} — one
+        # entry per reporting OS process, deduped by seq (co-located
+        # daemons ship the same process ring)
+        self.flight_sources: dict[tuple, dict] = {}
 
     def open(self, name: str, service: str) -> DaemonState:
         st = self.daemons.get(name)
@@ -131,7 +138,63 @@ class DaemonStateIndex:
         st.client_metrics = cm if isinstance(cm, dict) else {}
         st.last_report_mono = time.monotonic()
         st.reports += 1
+        # time-resolved leg: sample the MERGED counter state at the
+        # history cadence (maybe_sample also notices a counter moving
+        # backwards — a daemon-side perf reset — and drops that
+        # daemon's stale buckets)
+        self.history.maybe_sample(name, st.counters, st.schema)
+        ev = payload.get("events")
+        if isinstance(ev, dict):
+            self.ingest_events(ev)
         return st
+
+    def ingest_events(self, ring: dict) -> int:
+        """Merge one shipped flight-ring tail into its (pid, boot)
+        source entry; returns the number of NEW events stored."""
+        try:
+            pid = int(ring.get("pid") or 0)
+            boot = str(ring.get("boot") or pid)
+            mono_now = float(ring["mono_now"])
+            wall_now = float(ring["wall_now"])
+        except (KeyError, TypeError, ValueError):
+            return 0
+        src = self.flight_sources.get((pid, boot))
+        if src is None:
+            src = self.flight_sources[(pid, boot)] = {
+                "pid": pid, "boot": boot, "events": [],
+                "mono_now": mono_now, "wall_now": wall_now,
+                "max_seq": 0, "updated_mono": time.monotonic()}
+        # anchors refresh every report: the merge offset should come
+        # from the freshest dump-time clock pair
+        src["mono_now"], src["wall_now"] = mono_now, wall_now
+        src["updated_mono"] = time.monotonic()
+        added = 0
+        for e in ring.get("events") or []:
+            if not isinstance(e, dict):
+                continue
+            seq = e.get("seq")
+            if not isinstance(seq, int) or seq <= src["max_seq"]:
+                continue        # dup from a co-located daemon's report
+            src["events"].append(e)
+            src["max_seq"] = seq
+            added += 1
+        del src["events"][:-self.FLIGHT_SOURCE_EVENTS]
+        # rotate whole sources past the cap, oldest update first
+        while len(self.flight_sources) > self.MAX_FLIGHT_SOURCES:
+            oldest = min(self.flight_sources,
+                         key=lambda k:
+                         self.flight_sources[k]["updated_mono"])
+            del self.flight_sources[oldest]
+        return added
+
+    def flight_rings(self) -> list[dict]:
+        """Stored rings, shaped like flight.dump() output — the
+        merge_timelines input."""
+        return [{"pid": src["pid"], "boot": src["boot"],
+                 "mono_now": src["mono_now"],
+                 "wall_now": src["wall_now"],
+                 "events": list(src["events"])}
+                for src in self.flight_sources.values()]
 
     def cull(self) -> list[str]:
         """Evict daemons whose reports stopped; returns evicted names."""
@@ -139,6 +202,9 @@ class DaemonStateIndex:
                    if st.age > self.stale_after]
         for name in evicted:
             del self.daemons[name]
+            # its sample rings go with it (the flight ring does NOT:
+            # events are the post-mortem record of exactly such deaths)
+            self.history.drop(name)
         return evicted
 
     def render_sources(self) -> list[tuple[str, dict, dict]]:
@@ -241,16 +307,39 @@ class MgrDaemon(Dispatcher):
     def __init__(self, mon_addrs, modules: list[MgrModule] | None = None,
                  auth_key: bytes | None = None,
                  exporter_port: int | None = 0,
-                 name: str = "x", config=None):
+                 name: str = "x", config=None,
+                 admin_socket_path: str | None = None):
         self.name = name
-        from ceph_tpu.utils.config import Config, Option
-        # mgr-side knobs (hot: the exporter re-reads per scrape)
+        from ceph_tpu.utils.config import Config, ConfigError, Option
+        # mgr-side knobs (hot: the exporter re-reads per scrape, the
+        # history observer below reconfigures the live store)
+        history_opts = [
+            Option("mgr_history_slots", "int",
+                   MetricsHistory.DEFAULT_SLOTS,
+                   "samples retained per (daemon, metric) history "
+                   "ring; with the interval this is the lookback "
+                   "window, and it is the per-series memory bound",
+                   minimum=2),
+            Option("mgr_history_interval_s", "float",
+                   MetricsHistory.DEFAULT_INTERVAL_S,
+                   "minimum seconds between history samples of one "
+                   "daemon's merged counter state"),
+            Option("mgr_history_max_series", "int",
+                   MetricsHistory.DEFAULT_MAX_SERIES,
+                   "total (daemon, metric) history series cap — the "
+                   "global memory bound; overflow series are counted "
+                   "and skipped", minimum=1)]
         self.config = config if config is not None else Config([
             Option("mgr_max_client_series", "int", 64,
                    "cap on distinct ceph_client label values in "
                    "/metrics; overflow folds into ceph_client=\"_other\" "
                    "so a many-client swarm cannot explode the scrape",
                    minimum=2)])
+        for opt in history_opts:
+            try:
+                self.config.declare(opt)
+            except ConfigError:
+                pass            # caller-supplied config already has it
         self.messenger = Messenger(f"mgr.{name}", auth_key=auth_key)
         self.messenger.add_dispatcher(self)
         self.monc = MonClient(self.messenger, mon_addrs)
@@ -260,6 +349,41 @@ class MgrDaemon(Dispatcher):
             [BalancerModule(), PGAutoscalerModule()]
         self.health: dict = {}
         self.daemon_index = DaemonStateIndex()
+        self.daemon_index.history.configure(
+            slots=self.config.get("mgr_history_slots"),
+            interval_s=self.config.get("mgr_history_interval_s"),
+            max_series=self.config.get("mgr_history_max_series"))
+
+        def _on_history_knob(name: str, value) -> None:
+            key = name[len("mgr_history_"):]
+            self.daemon_index.history.configure(**{
+                {"slots": "slots", "interval_s": "interval_s",
+                 "max_series": "max_series"}[key]: value})
+        self.config.add_observer(
+            ("mgr_history_slots", "mgr_history_interval_s",
+             "mgr_history_max_series"), _on_history_knob)
+        self.asok = None
+        if admin_socket_path:
+            from ceph_tpu.utils.admin_socket import AdminSocket
+            self.asok = AdminSocket(admin_socket_path,
+                                    config=self.config)
+            self.asok.register_command(
+                "perf history",
+                lambda req: self.perf_history(
+                    req.get("metric"), daemon=req.get("daemon"),
+                    window_s=float(req.get("window", 60.0))),
+                "windowed math over the metrics-history rings: "
+                "metric=<name> [daemon=] [window=seconds]; omit "
+                "metric to list recorded metric names")
+            self.asok.register_command(
+                "timeline dump",
+                lambda req: self.timeline_dump(),
+                "causally-ordered cluster timeline: every reporting "
+                "process's flight ring merged with the mgr's own")
+            self.asok.register_command(
+                "history status",
+                lambda req: self.daemon_index.history.status(),
+                "metrics-history store: series/caps/resets")
         self.addr: tuple[str, int] | None = None
         # True while the mgrmap names us active; standbys keep their
         # (empty) digest to themselves so they can never overwrite the
@@ -295,6 +419,11 @@ class MgrDaemon(Dispatcher):
                 status["client_table"] = dict(sorted(
                     agg.items(),
                     key=lambda kv: -kv[1].get("ops", 0))[:15])
+                # dashboard sparkline feed: the most recently moving
+                # history series (windowed p99 for histograms, rates
+                # for counters), rendered as unicode microcharts
+                status["history_sparklines"] = \
+                    self.daemon_index.history.sparkline_data()
                 return status
             self.exporter = MetricsExporter(
                 port=self._exporter_port, health_cb=health_cb,
@@ -306,6 +435,8 @@ class MgrDaemon(Dispatcher):
             self._tick_loop())
         self._beacon_task = asyncio.get_running_loop().create_task(
             self._beacon_loop())
+        if self.asok is not None:
+            self.asok.start()
         dout("mgr", 1, "mgr up "
              + (f"(metrics on {self.exporter.addr})"
                 if self.exporter else "(no exporter)"))
@@ -315,10 +446,45 @@ class MgrDaemon(Dispatcher):
         for attr in ("_tick_task", "_beacon_task"):
             await reap(getattr(self, attr))
             setattr(self, attr, None)
+        if self.asok is not None:
+            self.asok.stop()
         if self.exporter is not None:
             await self.exporter.stop()
         await self.monc.close()
         await self.messenger.shutdown()
+
+    # -- time-resolved observability (the flight/history query plane) --------
+
+    def perf_history(self, metric: str | None, daemon: str | None = None,
+                     window_s: float = 60.0) -> dict:
+        """`perf history <metric> [--daemon] [--window]`: windowed math
+        over the sample rings. Without a metric, lists what the store
+        has recorded."""
+        hist = self.daemon_index.history
+        if not metric:
+            return {"metrics": hist.metrics(daemon),
+                    "daemons": hist.daemons(),
+                    "status": hist.status()}
+        return hist.query(metric, daemon=daemon, window_s=window_s)
+
+    def timeline_dump(self, extra_rings: list[dict] | None = None,
+                      window_s: float | None = None) -> dict:
+        """The merged cluster timeline: every reporting process's
+        shipped flight ring + the mgr's own process ring (+ any rings
+        the caller fetched itself, e.g. over a ProcShardPool control
+        channel), causally ordered by estimated time. A failure storm
+        reads as one interleaved story across OS processes."""
+        rings = self.daemon_index.flight_rings()
+        rings.append(flight.dump())
+        if extra_rings:
+            rings.extend(extra_rings)
+        events = flight.merge_timelines(rings)
+        if window_s is not None and events:
+            horizon = events[-1]["t_est"] - window_s
+            events = [e for e in events if e["t_est"] >= horizon]
+        return {"events": events,
+                "processes": sorted({e["boot"] for e in events}),
+                "sources": len(rings)}
 
     def _on_osdmap(self, payload: dict) -> None:
         from ceph_tpu.crush.osdmap import apply_map_payload

@@ -232,6 +232,30 @@ def render_metrics(health: dict | None = None, index=None,
     return "\n".join(out) + "\n"
 
 
+_SPARK_BARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(points: list) -> str:
+    """Values -> a unicode microchart, scaled to the series' own
+    min..max (shape over time is the signal; the numbers ride the
+    label). Non-numeric points render as gaps."""
+    nums = [p for p in points
+            if isinstance(p, (int, float)) and not isinstance(p, bool)]
+    if not nums:
+        return ""
+    lo, hi = min(nums), max(nums)
+    span = hi - lo
+    out = []
+    for p in points:
+        if not isinstance(p, (int, float)) or isinstance(p, bool):
+            out.append(" ")
+            continue
+        idx = int((p - lo) / span * (len(_SPARK_BARS) - 1)) if span \
+            else 0
+        out.append(_SPARK_BARS[idx])
+    return "".join(out)
+
+
 def render_dashboard(status: dict, health: dict | None) -> str:
     """Read-only cluster dashboard (one self-contained HTML page).
     Every cluster-supplied string is escaped: pool names and health
@@ -299,6 +323,25 @@ def render_dashboard(status: dict, health: dict | None) -> str:
     progress_html = ("<h2>progress</h2><ul>"
                      + "".join(progress_items) + "</ul>"
                      if progress_items else "")
+    # metrics-history sparklines (the mgr's time-resolved sample rings:
+    # windowed p99 for histograms, per-interval rates for counters)
+    spark_rows = []
+    for row in (status.get("history_sparklines") or [])[:24]:
+        if not isinstance(row, dict):
+            continue
+        points = row.get("points") or []
+        last = row.get("last")
+        last_s = f"{last:.3g}" if isinstance(last, (int, float)) \
+            and not isinstance(last, bool) else ""
+        spark_rows.append(
+            f"<tr><td>{esc(str(row.get('daemon', '')))}</td>"
+            f"<td>{esc(str(row.get('metric', '')))}</td>"
+            f"<td>{esc(sparkline(points))}</td>"
+            f"<td>{esc(last_s)}</td></tr>")
+    sparks_html = ("<h2>metrics history</h2><table><tr><th>daemon</th>"
+                   "<th>metric</th><th>trend</th><th>last</th></tr>"
+                   + "".join(spark_rows) + "</table>"
+                   if spark_rows else "")
     # recent traces (process-wide span collector; empty when tracing off)
     trace_rows = []
     for t in tracer.recent_traces(limit=15):
@@ -330,6 +373,7 @@ mons {', '.join(str(q) for q in
 {''.join(rows)}</table>
 {daemons_html}
 {clients_html}
+{sparks_html}
 {progress_html}
 {traces_html}
 <h2>mgr modules</h2><pre>{mods}</pre>

@@ -29,6 +29,7 @@ from typing import Awaitable, Callable
 
 from ceph_tpu.msg.messages import Message, MMgrConfigure, MMgrOpen, MMgrReport
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
+from ceph_tpu.utils import flight
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import PerfCountersCollection
 
@@ -76,6 +77,11 @@ class MgrClient(Dispatcher):
         self._addr: tuple | None = None
         self._schema_keys_sent: frozenset | None = None
         self._last_sent: dict = {}
+        # flight-recorder shipping cursor: only ring events with
+        # seq > cursor travel per report (the ring is process-wide, so
+        # co-located daemons each ship it — the mgr dedups by
+        # (boot, seq))
+        self._flight_cursor = 0
         self._task: asyncio.Task | None = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -127,9 +133,10 @@ class MgrClient(Dispatcher):
         self._conn = conn
         self._addr = tuple(addr)
         # fresh session: the mgr's state for us may be gone — resend the
-        # schema and the full counter values
+        # schema, the full counter values, and the whole flight ring
         self._schema_keys_sent = None
         self._last_sent = {}
+        self._flight_cursor = 0
         return conn
 
     def _safe(self, cb, default):
@@ -177,7 +184,15 @@ class MgrClient(Dispatcher):
         payload["progress"] = self._safe(self.progress_cb, [])
         payload["device_metrics"] = self._safe(self.device_cb, {})
         payload["client_metrics"] = self._safe(self.client_cb, {})
+        # flight-recorder leg: the ring tail since the last report,
+        # plus the anchor pair the mgr's timeline merge needs. Shipped
+        # every report (an empty tail still refreshes the anchors);
+        # cursor advances only after the send below cannot fail
+        ring = flight.events_since(self._flight_cursor)
+        payload["events"] = ring
         conn.send_message(MMgrReport(payload))
+        if ring["events"]:
+            self._flight_cursor = max(e["seq"] for e in ring["events"])
         self.reports_sent += 1
         return True
 

@@ -39,6 +39,7 @@ from ceph_tpu.msg.messages import (MLog, Message, MMgrMap, MMonCommand,
                                    MOSDFailure, MOSDMapMsg, MPing,
                                    MPingReply)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
+from ceph_tpu.utils import flight
 from ceph_tpu.utils.async_util import reap, reap_all
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import PerfCountersCollection
@@ -340,6 +341,9 @@ class OSDMonitor:
                     "WRN", f"mon.{self.mon.name}",
                     f"osd.{failed} marked down "
                     f"({len(reporters)} reporters: {sorted(reporters)})")
+                flight.record("osd_markdown", f"osd.{failed}",
+                              reporters=sorted(reporters),
+                              mon=self.mon.name)
             return True
         return False
 
@@ -901,10 +905,17 @@ class Monitor(Dispatcher):
                           f"mon.{self.name}",
                           f"Health check failed: "
                           f"{chk.get('summary')} ({code})")
+                flight.record("health_fail", code, severity=sev,
+                              summary=chk.get("summary", ""))
+                # WARN+ transition: freeze the ring — the run-up to a
+                # SLOW_OPS / PG_DEGRADED flip is exactly what an
+                # operator wants post-hoc
+                flight.snapshot(f"health:{code}")
         for code in self._prev_checks:
             if code not in checks:
                 self.clog("INF", f"mon.{self.name}",
                           f"Health check cleared: {code}")
+                flight.record("health_clear", code)
         self._prev_checks = {c: chk.get("severity", "HEALTH_WARN")
                              for c, chk in checks.items()}
 

@@ -73,7 +73,7 @@ from typing import Any, Callable
 import numpy as np
 
 from ceph_tpu.qa import faultinject, interleave
-from ceph_tpu.utils import copytrack, sanitizer, tracer
+from ceph_tpu.utils import copytrack, flight, sanitizer, tracer
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import (TYPE_GAUGE, TYPE_HISTOGRAM,
                                           PerfCountersCollection)
@@ -93,6 +93,7 @@ _DEFAULTS: dict[str, Any] = {
     "device_count": 0,
     "device_shard_bytes": 32 << 20,
     "device_spill_threshold": 2,
+    "device_peak_gbps": 0.0,
 }
 
 #: one service per event loop: a loop is one cluster's world (tests and
@@ -180,6 +181,18 @@ def _perf():
                description="bytes admitted and not yet completed")
         pc.add("inflight_batches", type=TYPE_GAUGE,
                description="batches occupying staging slots")
+        # per-kernel achieved bandwidth (EWMA over device batches) and
+        # its fraction of the configured device peak — the roofline
+        # gauges the metrics history trends per daemon. enc/dec/crc/rep
+        # mirror the _Bucket key kinds.
+        for kind in ("enc", "dec", "crc", "rep"):
+            pc.add(f"kernel_{kind}_gbps", type=TYPE_GAUGE,
+                   description=f"{kind} kernel achieved GB/s "
+                               f"(EWMA over device batches)")
+            pc.add(f"kernel_{kind}_roofline_pct", type=TYPE_GAUGE,
+                   description=f"{kind} kernel GB/s as % of "
+                               f"ec_offload_device_peak_gbps (0 when "
+                               f"no peak is configured)")
     return pc
 
 
@@ -491,6 +504,7 @@ class OffloadService:
         self.device_shard_bytes = int(_DEFAULTS["device_shard_bytes"])
         self.device_spill_threshold = max(
             1, int(_DEFAULTS["device_spill_threshold"]))
+        self.device_peak_gbps = float(_DEFAULTS["device_peak_gbps"])
         self._throttle = Throttle("ec_offload_queue",
                                   int(_DEFAULTS["max_queue_bytes"]))
         self._space = asyncio.Event()
@@ -528,6 +542,8 @@ class OffloadService:
         self._host_slot = _DeviceSlot(_DeviceState("host", None),
                                       self.pipeline_depth)
         self._last_error = ""
+        # per-kernel-kind achieved-GB/s EWMA backing the roofline gauges
+        self._kernel_gbps: dict[str, float] = {}
 
     @property
     def _topo(self) -> _Topology:
@@ -596,6 +612,8 @@ class OffloadService:
             self.device_shard_bytes = int(value)
         elif name == "ec_offload_device_spill_threshold":
             self.device_spill_threshold = max(1, int(value))
+        elif name == "ec_offload_device_peak_gbps":
+            self.device_peak_gbps = max(0.0, float(value))
 
     # -- dispatch topology ---------------------------------------------------
 
@@ -1247,6 +1265,7 @@ class OffloadService:
                 self.perf.inc("mesh_batches")
                 self.stats["mesh_batches"] += 1
                 self._note_mesh(n_ops, nbytes, busy)
+                self._note_kernel(bucket.key[0], nbytes, busy)
                 # this batch never probed the ROUTED chip: return OUR
                 # half-open claim, if _route granted one, or a device
                 # whose traffic all mesh-shards would stay out of
@@ -1282,8 +1301,9 @@ class OffloadService:
                     out = await self._device_call(slot, bucket.dispatch,
                                                   stacked, sp)
                     self._slot_success(slot)
-                    self._note_device(slot.label, n_ops, nbytes,
-                                      time.perf_counter() - t0)
+                    busy_s = time.perf_counter() - t0
+                    self._note_device(slot.label, n_ops, nbytes, busy_s)
+                    self._note_kernel(bucket.key[0], nbytes, busy_s)
                     return out, slot.label
                 except asyncio.CancelledError:
                     # un-claim the half-open probe _route may have
@@ -1308,6 +1328,9 @@ class OffloadService:
                     # see the extra load via the inflight count below.
                     self.perf.inc("device_failovers")
                     self.stats["device_failovers"] += 1
+                    flight.record("device_failover", slot.label,
+                                  to=nxt.label,
+                                  error=f"{type(e).__name__}: {e}")
                     nxt.inflight += 1
                     failover_slots.append(nxt)
                     slot = nxt
@@ -1336,6 +1359,22 @@ class OffloadService:
             d["busy_s"] += busy_s
             if fallback:
                 d["fallback_ops"] += n_ops
+
+    def _note_kernel(self, kind, nbytes: int, busy_s: float) -> None:
+        """Roofline gauges: achieved GB/s for this kernel kind (EWMA —
+        one tiny linger-flushed batch must not zero a healthy trend)
+        and, when a device peak is configured, its roofline fraction."""
+        if busy_s <= 0 or kind not in ("enc", "dec", "crc", "rep"):
+            return
+        gbps = nbytes / busy_s / 1e9
+        prev = self._kernel_gbps.get(kind)
+        ewma = gbps if prev is None else 0.7 * prev + 0.3 * gbps
+        self._kernel_gbps[kind] = ewma
+        self.perf.set(f"kernel_{kind}_gbps", round(ewma, 4))
+        peak = self.device_peak_gbps
+        if peak > 0:
+            self.perf.set(f"kernel_{kind}_roofline_pct",
+                          round(100.0 * ewma / peak, 2))
 
     def _note_mesh(self, n_ops: int, nbytes: int, busy_s: float) -> None:
         """A mesh batch occupies every device for its wall time; bytes
@@ -1400,6 +1439,7 @@ class OffloadService:
                  f"device {slot.label} recovered; back in rotation"
                  + ("" if self.degraded else
                     " (TPU_OFFLOAD_DEGRADED clears)"))
+            flight.record("breaker_recover", slot.label)
 
     def _slot_failure(self, slot: _DeviceSlot, e: Exception) -> None:
         state = slot.state
@@ -1424,6 +1464,9 @@ class OffloadService:
                  f"removed from rotation for {self.breaker_reset_s:.0f}s"
                  + (" — no devices left, host codec serves "
                     "(TPU_OFFLOAD_DEGRADED)" if self.degraded else ""))
+            flight.record("breaker_trip", slot.label,
+                          error=slot.last_error,
+                          all_degraded=self.degraded)
 
     # -- surfaces ------------------------------------------------------------
 
@@ -1601,6 +1644,11 @@ def OFFLOAD_OPTIONS():
                "inflight-batch lead over the least-busy device at "
                "which an affine bucket spills off its preferred chip",
                minimum=1),
+        Option("ec_offload_device_peak_gbps", "float",
+               _DEFAULTS["device_peak_gbps"],
+               "device memory-bandwidth peak in GB/s for the roofline "
+               "gauges (kernel_*_roofline_pct); 0 leaves them at zero "
+               "and only the absolute GB/s gauges move", minimum=0.0),
     ]
 
 

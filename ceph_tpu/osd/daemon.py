@@ -34,7 +34,8 @@ from ceph_tpu.objectstore.store import StoreError
 from ceph_tpu.osd.backend import IntervalChange
 from ceph_tpu.osd.pg import PGInstance
 from ceph_tpu.qa import faultinject
-from ceph_tpu.utils import copytrack, crash, loopprof, sanitizer, tracer
+from ceph_tpu.utils import (copytrack, crash, flight, loopprof, sanitizer,
+                            tracer)
 from ceph_tpu.utils.admin_socket import AdminSocket
 from ceph_tpu.utils.async_util import reap_all
 from ceph_tpu.utils.config import Config, Option
@@ -148,6 +149,10 @@ class OSD(Dispatcher):
         # fault_inject_enabled true` over the admin socket arms the
         # process-wide injector; the `inject` command fires one-shots
         faultinject.register_config(self.config)
+        # flight-recorder knobs (flight_*): `config set
+        # flight_ring_capacity 2048` resizes the process-wide event
+        # ring live; `config set flight_enabled false` silences it
+        flight.register_config(self.config)
         # the profiler/copy-ledger counter mirrors must exist before the
         # first MgrClient report so their families export from round one
         loopprof.perf()
@@ -882,6 +887,10 @@ class OSD(Dispatcher):
                             self.perf.inc("heartbeat_failures")
                             dout("osd", 2, f"osd.{self.whoami} reported "
                                            f"osd.{peer} down")
+                            flight.record(
+                                "heartbeat_failure", f"osd.{peer}",
+                                reporter=self.whoami,
+                                silent_s=round(now - last, 2))
                         except Exception:
                             self._hb_reported.discard(peer)
                         else:

@@ -85,6 +85,12 @@ class FaultInjector:
         if len(self.log) > LOG_CAP:
             del self.log[: len(self.log) - LOG_CAP]
         dout("inject", 4, f"fault {site}#{n}: {action} ({detail})")
+        # every fired fault is a flight event: a post-mortem timeline
+        # must show the injected cause next to its observed effects
+        # (local import: faultinject loads before most of the tree)
+        from ceph_tpu.utils import flight
+        flight.record("fault_injected", site, n=n, action=action,
+                      detail=detail)
 
     # -- arming ---------------------------------------------------------------
 

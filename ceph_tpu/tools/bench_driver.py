@@ -239,6 +239,39 @@ def stage_device() -> dict:
     _bench_into(results, "tpu_decode", plugin="tpu", mode="batched",
                 workload="decode", batch=batch, iterations=iters, warmup=2)
 
+    # Device memory-bandwidth peak: a saturating on-device elementwise
+    # sweep (read + write of a large resident buffer) — the roofline
+    # every codec GB/s is judged against. The guarded number below is
+    # tpu_encode as a PERCENT of this same-run peak: the r04->r05
+    # 35.2->32.0 slide re-baselined so backend/container drift that
+    # moves both numbers together no longer reads as a codec
+    # regression.
+    try:
+        import jax.numpy as jnp
+        nbytes = (256 if on_tpu else 32) << 20
+        arr = jnp.zeros(nbytes // 4, dtype=jnp.float32)
+        sweep_f = jax.jit(lambda x: x + 1.0)
+        jax.block_until_ready(sweep_f(arr))            # compile + warm
+        peak_iters = 10 if on_tpu else 3
+        times = []
+        for _ in range(peak_iters):
+            t1 = time.perf_counter()
+            jax.block_until_ready(sweep_f(arr))
+            times.append(time.perf_counter() - t1)
+        times.sort()
+        # read + write per element
+        peak = round(2 * nbytes / times[len(times) // 2] / 1e9, 2)
+        results["device_peak_gbps"] = peak
+        if peak > 0 and results.get("tpu_encode"):
+            results["tpu_encode_roofline_pct"] = round(
+                100.0 * results["tpu_encode"] / peak, 2)
+        log(f"device_peak: {peak} GB/s (elementwise sweep, median of "
+            f"{peak_iters}); tpu_encode at "
+            f"{results.get('tpu_encode_roofline_pct', 0.0)}% of peak")
+    except Exception as e:
+        log(f"device_peak: FAILED {type(e).__name__}: {e}")
+        results["device_peak_gbps"] = 0.0
+
     try:
         from ceph_tpu.ops import crc32c as crc_dev
         from ceph_tpu.tools.ec_benchmark import (_device_test_data,
@@ -1250,6 +1283,40 @@ def stage_failure_storm() -> dict:
                 stats["degraded_reads"]
             results["failure_storm_write_stalls"] = stats["stalls"]
 
+            # time-resolved storm curve: per-second write MB/s and
+            # client p99 across baseline -> kill -> degraded ->
+            # backfill. The BENCH line carries the whole series (the
+            # curve a flight-recorder timeline is read against); the
+            # trend guard watches its p99 area, which a latency
+            # regression ANYWHERE in the storm inflates even when the
+            # end-state numbers recover
+            if lat:
+                t0x = lat[0][0]
+                per_sec: dict[int, list] = {}
+                for t, ms, kind in lat:
+                    per_sec.setdefault(int(t - t0x), []).append((ms, kind))
+                timeline = []
+                for sec in sorted(per_sec):
+                    sam = per_sec[sec]
+                    mss = sorted(ms for ms, _ in sam)
+                    writes = sum(1 for _, k in sam if k == "write")
+                    timeline.append(
+                        {"t": sec,
+                         "write_mb_s": round(writes * obj / 1e6, 3),
+                         "p99_ms": round(
+                             mss[int(0.99 * (len(mss) - 1))], 2),
+                         "reads": len(sam) - writes,
+                         "writes": writes})
+                results["failure_storm_timeline"] = timeline
+                results["failure_storm_p99_area_ms_s"] = round(
+                    sum(p["p99_ms"] for p in timeline), 1)
+                if window["t_kill"] is not None:
+                    results["failure_storm_kill_at_s"] = round(
+                        window["t_kill"] - t0x, 2)
+                if window["t_revive"] is not None:
+                    results["failure_storm_revive_at_s"] = round(
+                        window["t_revive"] - t0x, 2)
+
             # final verification: every object byte-identical to A
             # written generation — an uncertain (timed-out) write may
             # have landed late, but the bytes must never be garbage
@@ -1311,6 +1378,145 @@ def stage_failure_storm() -> dict:
                 f"({fetched_b} of {full_b} full-gather bytes)")
 
     asyncio.run(asyncio.wait_for(body(), 280))
+
+    # -- phase C: flight-recorder drill — 3 OSDs killed AS A PROCESS.
+    # A 6-OSD cluster over 2 worker processes (parent keeps mon +
+    # client), worker shard1 (osds 0/2/4) SIGKILLed via the control
+    # channel, a device fault armed on a survivor so the offload
+    # breaker trips in worker shard2, then respawn and recover. The
+    # merged `timeline dump` must tell the story in causal order
+    # across >= 2 OS processes: injection -> mark-downs -> breaker
+    # trip -> recovery-complete (OSD_DOWN health clear).
+    async def drill():
+        from ceph_tpu.mgr.daemon import MgrDaemon
+        from ceph_tpu.tools.cluster_boot import ephemeral_cluster
+        from ceph_tpu.utils import flight
+
+        flight.reset()              # focus the ring on this drill
+        loop = asyncio.get_running_loop()
+
+        async def wait_flight(etype, entity_sub="", timeout=60.0):
+            deadline = loop.time() + timeout
+            while loop.time() < deadline:
+                for e in flight.dump(etype)["events"]:
+                    if entity_sub in e["entity"]:
+                        return True
+                await asyncio.sleep(0.25)
+            return False
+
+        async with ephemeral_cluster(6, prefix="bench-drill-",
+                                     reactor_procs=2) \
+                as (client, osds, mon):
+            mon_addrs = list(mon.monmap.mons.values())
+            mgr = MgrDaemon(mon_addrs, modules=[], exporter_port=None)
+            await mgr.start()
+            try:
+                await client.command({
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "drillprof",
+                    "profile": {"plugin": "tpu", "k": "2", "m": "1"}})
+                await client.pool_create(
+                    "drill", pg_num=4, pool_type="erasure",
+                    erasure_code_profile="drillprof")
+                io = client.ioctx("drill")
+                obj = client.osdmap.get_pool("drill").stripe_width
+                for i in range(6):
+                    await io.write_full(f"d{i:02d}", bytes([i]) * obj)
+
+                # kill worker shard1 = osds 0/2/4 (place = 1 + seq%2)
+                pool_h = osds[0].pool
+                dead = [0, 2, 4]
+                await pool_h.inject_crash(1)
+                deadline = loop.time() + 40.0
+                down_ok = False
+                while loop.time() < deadline and not down_ok:
+                    m = mon.osdmon.osdmap
+                    down_ok = all(i in m.osds and not m.osds[i].up
+                                  for i in dead)
+                    await asyncio.sleep(0.25)
+                results["failure_storm_drill_marked_down"] = down_ok
+
+                # breaker trip in the SURVIVING worker: threshold 1 +
+                # armed device fault, then degraded writes until the
+                # trip shows in shard2's ring
+                surv = osds[1]                      # shard 2
+                await surv.config_set(
+                    "ec_offload_breaker_threshold", 1)
+                await surv.admin({"prefix": "inject", "what": "device",
+                                  "count": 2, "whoami": surv.whoami})
+                tripped = False
+                for i in range(40):
+                    try:
+                        await client.submit(
+                            "drill", f"w{i:02d}",
+                            [{"op": "write_full", "oid": f"w{i:02d}"}],
+                            bytes([i]) * obj, timeout=4.0)
+                    except Exception:
+                        pass                # peering/remap in progress
+                    try:
+                        ring = await surv.admin(
+                            {"prefix": "events dump",
+                             "type": "breaker_trip"}, timeout=5.0)
+                        tripped = bool(ring["events"])
+                    except Exception:
+                        tripped = False
+                    if tripped:
+                        break
+                    await asyncio.sleep(0.25)
+                results["failure_storm_drill_breaker_tripped"] = tripped
+
+                # respawn the dead worker; recovery-complete = the
+                # mon's OSD_DOWN health check clearing (a flight event
+                # in the parent ring)
+                await pool_h.respawn(1)
+                recovered = await wait_flight("health_clear",
+                                              "OSD_DOWN", timeout=60.0)
+                results["failure_storm_drill_recovered"] = recovered
+
+                # merge: every worker's ring over the control channel +
+                # the parent ring + whatever the mgr's report fan-in
+                # already collected (dedup by (boot, seq) makes the
+                # overlap harmless)
+                extra = []
+                for ref in (osds[0], osds[1]):
+                    try:
+                        extra.append(await ref.admin("events dump",
+                                                     timeout=5.0))
+                    except Exception:
+                        pass
+                tl = mgr.timeline_dump(extra_rings=extra)
+                ev = tl["events"]
+
+                def first(etype, sub=""):
+                    for i, e in enumerate(ev):
+                        if e["type"] == etype and sub in e["entity"]:
+                            return i
+                    return None
+                i_inj = first("inject_crash")
+                i_down = first("osd_markdown")
+                i_trip = first("breaker_trip")
+                i_rec = first("health_clear", "OSD_DOWN")
+                order = [i_inj, i_down, i_trip, i_rec]
+                results["failure_storm_drill_causal_ok"] = (
+                    None not in order and order == sorted(order))
+                results["failure_storm_drill_events"] = len(ev)
+                results["failure_storm_drill_processes"] = len(
+                    tl["processes"])
+                log(f"failure_storm drill: events={len(ev)} "
+                    f"processes={tl['processes']} "
+                    f"order={order} causal_ok="
+                    f"{results['failure_storm_drill_causal_ok']}")
+            finally:
+                await mgr.stop()
+
+    try:
+        asyncio.run(asyncio.wait_for(drill(), 170))
+    except Exception as e:
+        # the drill is an observability demonstration: a flaky respawn
+        # or health wait must not discard phase A/B's guarded numbers
+        results["failure_storm_drill_error"] = \
+            f"{type(e).__name__}: {e}"
+        log(f"failure_storm drill failed: {type(e).__name__}: {e}")
     return results
 
 
@@ -1434,6 +1640,28 @@ def stage_swarm() -> dict:
                     and "SLO_VIOLATIONS" in h.get("muted", {}))
                 log(f"swarm: SLO_VIOLATIONS muted="
                     f"{results['swarm_slo_muted']}")
+                # time-resolved leg: the mgr's metrics history sampled
+                # the whole storm through the MMgrReport fan-in — emit
+                # each OSD's per-second op-rate curve (the SHAPE the
+                # QoS work will be graded on) plus the windowed p99
+                # the history math recomputes from the bucket deltas
+                hist = c.mgr.daemon_index.history
+                curves = {}
+                for daemon, samples in hist.series("op").items():
+                    curves[daemon] = [
+                        round((b - a) / max(tb - ta, 1e-9), 1)
+                        for (ta, a), (tb, b) in zip(samples,
+                                                    samples[1:])][-12:]
+                results["swarm_op_rate_series"] = curves
+                q = hist.query("op_total_us", window_s=SECONDS + 30)
+                results["swarm_history_p99_ms"] = {
+                    d: e.get("p99_ms")
+                    for d, e in q["daemons"].items()}
+                hst = hist.status()
+                results["swarm_history_series"] = hst["series"]
+                log(f"swarm: mgr history {hst['series']} series over "
+                    f"{hst['daemons']} daemons, per-second op curves "
+                    f"for {len(curves)} OSD(s)")
             finally:
                 await c.stop()
 
@@ -1677,12 +1905,14 @@ def stage_attribution() -> dict:
 
 def stage_interleave() -> dict:
     """The interlock qa sweep as a bench stage: seed-swept schedule
-    exploration over a pipelined EC cluster, run twice — explorer only,
-    then explorer + full sanitizer (generation guards, lockset
-    recorder, debug mode) — so the JSON line carries seeds run,
-    distinct schedules explored, and the sanitizer-mode overhead the
-    trend guard watches (a creeping guard cost would quietly price the
-    qa tier out of CI)."""
+    exploration over a pipelined EC cluster, run three ways — explorer
+    only (flight recorder off), explorer + full sanitizer (generation
+    guards, lockset recorder, debug mode), and explorer + the full
+    observability plane (flight recorder on + a live mgr sampling
+    metrics history from every daemon's reports) — so the JSON line
+    carries seeds run, distinct schedules explored, and BOTH overheads
+    the trend guard watches (a creeping guard or recorder cost would
+    quietly price the qa tier out of CI)."""
     import asyncio
 
     t0 = time.perf_counter()
@@ -1690,10 +1920,11 @@ def stage_interleave() -> dict:
     KI, MI = 2, 1
     OBJ = KI * 4096
 
-    async def sweep(armed: bool) -> tuple[float, set, int]:
+    async def sweep(armed: bool,
+                    recorder: bool = False) -> tuple[float, set, int]:
         from ceph_tpu.qa import interleave
         from ceph_tpu.tools.cluster_boot import ephemeral_cluster
-        from ceph_tpu.utils import sanitizer
+        from ceph_tpu.utils import flight, sanitizer
         digests: set = set()
         decisions = 0
         async with ephemeral_cluster(KI + MI, prefix="bench-ilv-") \
@@ -1710,6 +1941,17 @@ def stage_interleave() -> dict:
             for o in osds:
                 o.config.set("osd_pg_pipeline_depth", 4)
             loop = asyncio.get_running_loop()
+            # the recorder mode measures the WHOLE observability plane:
+            # flight ring armed + a live mgr whose report fan-in feeds
+            # the metrics-history sampler; the other modes run with the
+            # ring off so the baseline stays un-instrumented
+            flight.configure(enabled=recorder)
+            mgr = None
+            if recorder:
+                from ceph_tpu.mgr.daemon import MgrDaemon
+                mgr = MgrDaemon(list(_mon.monmap.mons.values()),
+                                modules=[], exporter_port=None)
+                await mgr.start()
             if armed:
                 sanitizer.install(loop, slow_callback_s=5.0)
             try:
@@ -1739,11 +1981,14 @@ def stage_interleave() -> dict:
                 if armed:
                     sanitizer.uninstall(loop)
                     sanitizer.clear_lockset_conflicts()
+                if mgr is not None:
+                    await mgr.stop()
+                flight.configure(enabled=True)
         return elapsed, digests, decisions
 
-    # alternate A/B and take per-mode minima: the 2-core container is
+    # alternate A/B/C and take per-mode minima: the 2-core container is
     # noisy, and min-of-reps is the steadier overhead estimator
-    plain_s, armed_s = [], []
+    plain_s, armed_s, flight_s = [], [], []
     schedules: set = set()
     decisions = 0
     for _ in range(REPS):
@@ -1755,19 +2000,28 @@ def stage_interleave() -> dict:
         armed_s.append(el)
         schedules |= dg
         decisions += dc
-    base, guarded = min(plain_s), min(armed_s)
+        el, dg, dc = asyncio.run(asyncio.wait_for(
+            sweep(False, recorder=True), 180))
+        flight_s.append(el)
+        schedules |= dg
+        decisions += dc
+    base, guarded, rec = min(plain_s), min(armed_s), min(flight_s)
     overhead = max(0.0, (guarded - base) / base * 100.0) if base else 0.0
+    rec_overhead = max(0.0, (rec - base) / base * 100.0) if base else 0.0
     log(f"interleave: {SEEDS} seeds x {REPS} reps, "
         f"{len(schedules)} schedules, plain {base:.2f}s vs "
-        f"sanitizer {guarded:.2f}s (+{overhead:.0f}%)")
+        f"sanitizer {guarded:.2f}s (+{overhead:.0f}%) vs "
+        f"recorder+history {rec:.2f}s (+{rec_overhead:.0f}%)")
     return {
         "platform": "cpu",
-        "interleave_seeds": SEEDS * REPS * 2,
+        "interleave_seeds": SEEDS * REPS * 3,
         "interleave_schedules_explored": len(schedules),
         "interleave_decisions": decisions,
         "interleave_plain_s": round(base, 3),
         "interleave_sanitizer_s": round(guarded, 3),
         "interleave_sanitizer_overhead_pct": round(overhead, 1),
+        "interleave_flight_s": round(rec, 3),
+        "flight_history_overhead_pct": round(rec_overhead, 1),
         "elapsed_s": round(time.perf_counter() - t0, 1),
     }
 
@@ -1783,7 +2037,12 @@ TREND_KEYS = ("tpu_encode", "tpu_decode", "failure_storm_recovery_mb_s",
               "scaling_efficiency", "cluster_ec_write_mb_s",
               "cluster_ec_tpu_write_mb_s_sharded",
               "cluster_ec_write_mb_s_procs", "swarm_mb_s",
-              "offload_mean_batch_ops")
+              "offload_mean_batch_ops",
+              # the r04->r05 35.2->32.0 GB/s slide, re-baselined as a
+              # fraction of the measured device peak: normalizing by
+              # the same-run peak keeps the guard meaningful across
+              # container/backend drift that moves BOTH numbers
+              "tpu_encode_roofline_pct")
 #: keys where UP is the regression direction: more copied bytes per
 #: written byte, a busier event loop, a slower recovery to clean, a
 #: repair fetch creeping back toward the full-stripe baseline, the
@@ -1798,7 +2057,9 @@ TREND_KEYS_COST = ("copy_amplification", "loop_busy_fraction",
                    "swarm_p99_fairness", "python_us_per_op",
                    "msgr_frames_per_ec_write",
                    "pg_pipeline_stall_fraction",
-                   "interleave_sanitizer_overhead_pct")
+                   "interleave_sanitizer_overhead_pct",
+                   "flight_history_overhead_pct",
+                   "failure_storm_p99_area_ms_s")
 TREND_THRESHOLD_PCT = 10.0
 
 

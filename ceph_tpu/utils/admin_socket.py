@@ -54,9 +54,35 @@ class AdminSocket:
                               "dump perf counter values")
         self.register_command("perf schema", lambda req: pc.schema(),
                               "dump perf counter schema")
-        self.register_command("perf reset",
-                              lambda req: pc.reset(req.get("logger")),
-                              "zero all perf counters (or one logger's)")
+        from ceph_tpu.utils import flight
+
+        def _perf_reset(req):
+            out = pc.reset(req.get("logger"))
+            # a perf reset means "start my observation over": the local
+            # flight ring is part of that observation surface, and a
+            # stale event tail would contradict the zeroed counters.
+            # The mgr side notices the counters moving backwards and
+            # drops this daemon's history buckets on its own.
+            out["flight_cleared"] = flight.reset()["cleared"]
+            return out
+        self.register_command("perf reset", _perf_reset,
+                              "zero all perf counters (or one "
+                              "logger's) and clear the local "
+                              "flight-recorder ring")
+        self.register_command(
+            "events dump",
+            lambda req: flight.dump(req.get("type"), req.get("entity")),
+            "flight-recorder ring (structured events, oldest first) "
+            "with the mono/wall anchor pair; type=/entity= filter")
+        self.register_command(
+            "events reset",
+            lambda req: flight.reset(),
+            "clear the flight-recorder ring (snapshots survive)")
+        self.register_command(
+            "events snapshots",
+            lambda req: flight.snapshots(),
+            "auto-frozen flight rings (crash records, WARN+ health "
+            "transitions)")
         self.register_command("dump_recent",
                               lambda req: get_logger().ring.entries(),
                               "recent log events")

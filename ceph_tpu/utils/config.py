@@ -138,6 +138,12 @@ class Config:
             self._values[level][name] = value
             new = self.get(name)
         if new != old:
+            # hot config changes are flight events: a post-mortem
+            # timeline must show the knob turn that preceded the
+            # behavior change (local import — flight rides on Option
+            # for its own knobs, so a module-level import would cycle)
+            from ceph_tpu.utils import flight
+            flight.record("config_change", name, old=old, new=new)
             self._notify([name])
 
     def rm(self, name: str, level: int = LEVEL_OVERRIDE) -> None:

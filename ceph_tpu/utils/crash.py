@@ -76,6 +76,13 @@ def record(entity: str, exc: BaseException,
             del _records[: len(_records) - MAX_RECORDS]
     from ceph_tpu.utils.dout import dout
     dout("crash", 1, f"{entity} crash recorded: {exc_type}: {message}")
+    # black-box the moment: the crash event itself plus a frozen copy
+    # of the flight ring — the events LEADING UP to the crash must
+    # survive later wraparound (local import: flight pulls dout, and
+    # this module must stay importable from anywhere)
+    from ceph_tpu.utils import flight
+    flight.record("crash", entity, exc_type=exc_type, message=message)
+    flight.snapshot(f"crash:{entity}:{exc_type}")
     return rec
 
 

@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from ceph_tpu.utils import flight
 from ceph_tpu.utils.async_util import reap_all
 from ceph_tpu.utils.dout import dout
 
@@ -576,6 +577,10 @@ class ProcShardPool:
                          f"{self.name}: worker shard{w.index} died "
                          f"(rc {w.proc.returncode}); reaped — its OSDs "
                          f"will be marked down via heartbeat loss")
+                    flight.record("worker_death", f"shard{w.index}",
+                                  pool=self.name, pid=w.proc.pid,
+                                  rc=w.proc.returncode,
+                                  osds=sorted(w.boot_specs))
 
     # -- placement / identity -------------------------------------------------
 
@@ -726,6 +731,8 @@ class ProcShardPool:
         crash): a connection torn down before the response flushed
         still means the kill fired."""
         import json
+        flight.record("inject_crash", f"shard{index}", pool=self.name,
+                      osds=sorted(self._worker(index).boot_specs))
         try:
             return await self.call(index, {"prefix": "inject",
                                            "what": "crash"},
@@ -777,6 +784,8 @@ class ProcShardPool:
         dout("reactor", 1, f"{self.name}: worker shard{index} respawned "
                            f"(pid {w.proc.pid}), {len(booted)} OSD(s) "
                            f"re-booted")
+        flight.record("worker_respawn", f"shard{index}", pool=self.name,
+                      pid=w.proc.pid, osds_rebooted=len(booted))
         return {"pid": w.proc.pid, "osds": booted}
 
     # -- cross-process observability ------------------------------------------

@@ -41,6 +41,7 @@ import threading
 import time
 from typing import Awaitable, Callable
 
+from ceph_tpu.utils import flight
 from ceph_tpu.utils.async_util import being_cancelled
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import (TYPE_GAUGE, PerfCounters,
@@ -535,6 +536,9 @@ class OpTracker:
             self.historic_slow.append(op)
             dout("optracker", 2,
                  f"slow op ({op.duration:.3f}s): {op.description}")
+            flight.record("slow_op", op.client or "",
+                          duration_s=round(op.duration, 3),
+                          description=op.description)
 
     def dump_ops_in_flight(self) -> dict:
         return {"num_ops": len(self.ops_in_flight),
@@ -695,6 +699,10 @@ class ShardedOpQueue:
         self.perf = perf
         self._inflight_total = 0
         self.window_stalls = 0
+        # flight-recorder rate limit: a saturated window can stall
+        # thousands of times a second, and the black box wants "the
+        # queue was stalling around t", not a flooded ring
+        self._last_stall_flight = 0.0
         self.processed = 0
         self.processed_by_class = collections.Counter()
 
@@ -906,6 +914,13 @@ class ShardedOpQueue:
                     self.window_stalls += 1
                     if self.perf is not None:
                         self.perf.inc("pg_pipeline_window_stalls")
+                    now = time.monotonic()
+                    if now - self._last_stall_flight >= 0.5:
+                        self._last_stall_flight = now
+                        flight.record(
+                            "pg_window_stall", self.name, shard=shard,
+                            stalls=self.window_stalls,
+                            depth=self.pipeline_depth)
                 await self._wake[shard].wait()
                 continue
             klass, key, obj, work = picked

@@ -1,0 +1,11 @@
+"""Known-positive decl-use: flight/history knobs and counters declared
+the way a lazy port would — no observer family, no reader, no writer —
+so they rot as dead surface the lint must flag (one dead Option, one
+ghost gauge)."""
+
+
+def declare(config, perf, Option):
+    config.declare(Option("flightdead_ring_bytes", "int", 0,
+                          "capacity knob nobody applies"))
+    perf.add("rooflinedead_gbps",
+             description="gauge nobody ever sets")

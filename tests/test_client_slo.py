@@ -432,8 +432,10 @@ def test_mgr_digest_slo_checks():
 def test_perf_reset_clears_client_tables_and_buckets(tmp_path):
     """The perf-reset satellite: after admin-socket `perf reset`, a
     fresh exporter scrape shows EMPTY histogram buckets and a zeroed
-    client table — reset must reach bucket arrays and the per-client
-    tables, not just scalar counters."""
+    client table — reset must reach bucket arrays, the per-client
+    tables, AND the local flight-recorder ring (a stale event tail
+    would contradict the zeroed counters), not just scalar counters."""
+    from ceph_tpu.utils import flight
     coll = PerfCountersCollection.instance()
     coll.remove("resetscrape.test")
     coll.remove("resetscrape.clients")
@@ -446,6 +448,8 @@ def test_perf_reset_clears_client_tables_and_buckets(tmp_path):
     op = trk.create("w", client="client.r")
     op.kind, op.wr_bytes = "write", 512
     op.finish()
+    flight.reset()
+    flight.record("slow_op", "client.r", duration_s=1.0)
     asok = AdminSocket(str(tmp_path / "asok"))
     try:
         text = render_metrics()      # local-registry fallback scrape
@@ -456,6 +460,10 @@ def test_perf_reset_clears_client_tables_and_buckets(tmp_path):
         out = asok.execute({"prefix": "perf reset"})
         assert "resetscrape.test" in out["result"]["reset"]
         assert "resetscrape.clients" in out["result"]["reset"]
+        # the flight ring is part of the observation surface perf
+        # reset restarts: the event above is gone, and the verb says so
+        assert out["result"]["flight_cleared"] == 1
+        assert flight.dump()["events"] == []
         text = render_metrics()
         # cumulative bucket rows vanish (no buckets recorded), count=0
         assert 'ceph_h_us_bucket{ceph_daemon="resetscrape.test",' \

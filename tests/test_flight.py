@@ -1,0 +1,301 @@
+"""Flight recorder: ring bounds, hybrid timestamps, cross-process
+merge, auto-snapshots, and the admin/config surfaces."""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ceph_tpu.mon.monitor import Monitor
+from ceph_tpu.utils import crash, flight
+from ceph_tpu.utils.admin_socket import AdminSocket
+from ceph_tpu.utils.config import Config
+
+
+@pytest.fixture(autouse=True)
+def clean_flight():
+    flight.configure(enabled=True, capacity=flight.DEFAULT_CAPACITY)
+    flight.reset()
+    flight.clear_snapshots()
+    yield
+    flight.configure(enabled=True, capacity=flight.DEFAULT_CAPACITY)
+    flight.reset()
+    flight.clear_snapshots()
+
+
+# -- ring mechanics -----------------------------------------------------------
+
+def test_ring_evicts_oldest_past_capacity():
+    flight.configure(capacity=8)
+    seq0 = flight.last_seq()    # process-global, monotonic across resets
+    for i in range(20):
+        flight.record("tick", f"e{i}", i=i)
+    ring = flight.dump()
+    assert len(ring["events"]) == 8
+    # oldest dropped, newest kept, order preserved
+    assert [e["detail"]["i"] for e in ring["events"]] == list(range(12, 20))
+    assert ring["dropped"] == 12
+    st = flight.status()
+    assert st["events"] == 8 and st["dropped"] == 12
+    assert st["seq"] == seq0 + 20
+
+
+def test_shrinking_capacity_trims_live_ring():
+    flight.configure(capacity=64)
+    for i in range(30):
+        flight.record("tick", "e", i=i)
+    flight.configure(capacity=10)
+    ring = flight.dump()
+    assert len(ring["events"]) == 10
+    assert ring["events"][0]["detail"]["i"] == 20
+
+
+def test_disabled_recorder_records_nothing():
+    flight.configure(enabled=False)
+    assert flight.record("tick", "e") is None
+    assert flight.dump()["events"] == []
+    flight.configure(enabled=True)
+    assert flight.record("tick", "e") is not None
+
+
+def test_dump_filters_by_type_and_entity():
+    flight.record("slow_op", "osd.0", duration_s=1.0)
+    flight.record("slow_op", "osd.1", duration_s=2.0)
+    flight.record("breaker_trip", "tpu:0")
+    assert len(flight.dump("slow_op")["events"]) == 2
+    assert len(flight.dump(None, "osd.1")["events"]) == 1
+    only = flight.dump("slow_op", "osd.0")["events"]
+    assert len(only) == 1 and only[0]["detail"]["duration_s"] == 1.0
+
+
+def test_events_since_cursor_ships_only_the_tail():
+    for i in range(5):
+        flight.record("tick", "e", i=i)
+    cursor = flight.last_seq()
+    assert flight.events_since(cursor)["events"] == []
+    flight.record("tick", "e", i=5)
+    flight.record("tick", "e", i=6)
+    tail = flight.events_since(cursor)["events"]
+    assert [e["detail"]["i"] for e in tail] == [5, 6]
+    # anchors ride every incremental dump too
+    ring = flight.events_since(0)
+    assert "mono_now" in ring and "wall_now" in ring and "boot" in ring
+
+
+def test_reset_clears_ring_but_keeps_snapshots():
+    flight.record("tick", "e")
+    flight.snapshot("incident")
+    out = flight.reset()
+    assert out["cleared"] == 1
+    assert flight.dump()["events"] == []
+    snaps = flight.snapshots()
+    assert len(snaps) == 1 and snaps[0]["reason"] == "incident"
+    assert len(snaps[0]["events"]) == 1
+
+
+def test_snapshot_store_is_bounded():
+    for i in range(flight.MAX_SNAPSHOTS + 5):
+        flight.snapshot(f"s{i}")
+    snaps = flight.snapshots()
+    assert len(snaps) == flight.MAX_SNAPSHOTS
+    assert snaps[-1]["reason"] == f"s{flight.MAX_SNAPSHOTS + 4}"
+
+
+# -- hybrid timestamps / cross-process merge ----------------------------------
+
+def _ring(boot, offset_wall, events):
+    """Fabricate a dump as another process would produce it: anchor
+    pair taken at dump time, events carrying mono stamps."""
+    mono_now = 1000.0
+    return {"pid": 1, "boot": boot, "mono_now": mono_now,
+            "wall_now": mono_now + offset_wall, "dropped": 0,
+            "enabled": True, "capacity": 512,
+            "events": [dict(e) for e in events]}
+
+
+def test_merge_orders_across_processes_by_estimated_wall():
+    a = _ring("a", 5_000.0, [
+        {"seq": 1, "mono": 10.0, "wall": 0.0, "type": "inject",
+         "entity": "x", "detail": {}},
+        {"seq": 2, "mono": 30.0, "wall": 0.0, "type": "recover",
+         "entity": "x", "detail": {}}])
+    b = _ring("b", 5_000.0, [
+        {"seq": 1, "mono": 20.0, "wall": 0.0, "type": "trip",
+         "entity": "y", "detail": {}}])
+    merged = flight.merge_timelines([a, b])
+    assert [e["type"] for e in merged] == ["inject", "trip", "recover"]
+    assert all("t_est" in e for e in merged)
+
+
+def test_merge_survives_wall_clock_jump_mono_is_authoritative():
+    # mid-run the wall clock jumped BACK an hour: the recorded `wall`
+    # stamps are garbage (later event carries an earlier wall time) but
+    # mono keeps counting, so merge order must not change
+    events = [
+        {"seq": 1, "mono": 10.0, "wall": 10_000.0, "type": "before",
+         "entity": "", "detail": {}},
+        {"seq": 2, "mono": 20.0, "wall": 6_400.0, "type": "after",
+         "entity": "", "detail": {}},    # wall went backwards!
+    ]
+    merged = flight.merge_timelines([_ring("a", 5_000.0, events)])
+    assert [e["type"] for e in merged] == ["before", "after"]
+    assert merged[0]["t_est"] < merged[1]["t_est"]
+    # and the estimated axis derives from mono + anchor offset, not
+    # from the corrupted wall stamps
+    assert merged[1]["t_est"] - merged[0]["t_est"] == pytest.approx(10.0)
+
+
+def test_merge_dedups_same_ring_seen_twice():
+    ev = [{"seq": 1, "mono": 1.0, "wall": 0.0, "type": "t",
+           "entity": "", "detail": {}}]
+    merged = flight.merge_timelines(
+        [_ring("a", 0.0, ev), _ring("a", 0.0, ev)])
+    assert len(merged) == 1
+
+
+def test_merge_tolerates_malformed_rings():
+    ok = _ring("a", 0.0, [{"seq": 1, "mono": 1.0, "wall": 0.0,
+                           "type": "t", "entity": "", "detail": {}}])
+    merged = flight.merge_timelines(
+        [None, "junk", {}, {"mono_now": "x", "wall_now": 0},
+         {"mono_now": 0.0, "wall_now": 0.0, "events": [None, {"a": 1}]},
+         ok])
+    assert len(merged) == 1
+
+
+def test_live_dump_anchor_matches_local_clocks():
+    flight.record("tick", "e")
+    ring = flight.dump()
+    assert abs(ring["mono_now"] - time.monotonic()) < 5.0
+    assert abs(ring["wall_now"] - time.time()) < 5.0
+    merged = flight.merge_timelines([ring])
+    assert len(merged) == 1 and abs(
+        merged[0]["t_est"] - time.time()) < 5.0
+
+
+# -- auto-snapshots -----------------------------------------------------------
+
+def test_crash_record_freezes_flight_ring():
+    flight.record("slow_op", "osd.0", duration_s=2.5)
+    crash.record("osd.99", ValueError("boom-flight-test"))
+    try:
+        ring = flight.dump("crash")
+        assert len(ring["events"]) == 1
+        assert ring["events"][0]["detail"]["exc_type"] == "ValueError"
+        snaps = [s for s in flight.snapshots()
+                 if s["reason"] == "crash:osd.99:ValueError"]
+        assert len(snaps) == 1
+        # the run-up (the slow op BEFORE the crash) is in the freeze
+        assert [e["type"] for e in snaps[0]["events"]] == \
+            ["slow_op", "crash"]
+    finally:
+        crash.reset()
+
+
+def test_crash_recurrence_does_not_snapshot_again():
+    try:
+        crash.record("osd.98", ValueError("same"))
+        n = len(flight.snapshots())
+        crash.record("osd.98", ValueError("same"))   # coalesced
+        assert len(flight.snapshots()) == n
+    finally:
+        crash.reset()
+
+
+class _FakeMon:
+    """Just enough Monitor for _log_health_transitions."""
+    name = "a"
+
+    def __init__(self):
+        self._prev_checks = {}
+        self._checks = {}
+        self.logged = []
+
+    def clog(self, level, who, message):
+        self.logged.append((level, message))
+
+    def _raw_health_checks(self):
+        return self._checks
+
+
+def test_warn_health_transition_records_and_snapshots():
+    mon = _FakeMon()
+    mon._checks = {"SLOW_OPS": {"severity": "HEALTH_WARN",
+                                "summary": "3 slow ops"}}
+    Monitor._log_health_transitions(mon)
+    fails = flight.dump("health_fail")["events"]
+    assert len(fails) == 1 and fails[0]["entity"] == "SLOW_OPS"
+    assert fails[0]["detail"]["severity"] == "HEALTH_WARN"
+    snaps = [s for s in flight.snapshots()
+             if s["reason"] == "health:SLOW_OPS"]
+    assert len(snaps) == 1
+    # same severity next tick: no re-fire, no snapshot churn
+    Monitor._log_health_transitions(mon)
+    assert len(flight.dump("health_fail")["events"]) == 1
+    assert len(flight.snapshots()) == len(snaps)
+    # cleared: a clear event, no snapshot
+    mon._checks = {}
+    Monitor._log_health_transitions(mon)
+    clears = flight.dump("health_clear")["events"]
+    assert len(clears) == 1 and clears[0]["entity"] == "SLOW_OPS"
+    assert len(flight.snapshots()) == len(snaps)
+
+
+# -- admin + config surfaces --------------------------------------------------
+
+def test_asok_events_verbs(tmp_path):
+    asok = AdminSocket(str(tmp_path / "asok"))
+    flight.record("slow_op", "osd.0")
+    flight.record("breaker_trip", "tpu:0")
+    out = asok.execute({"prefix": "events dump"})["result"]
+    assert len(out["events"]) == 2 and "mono_now" in out
+    out = asok.execute({"prefix": "events dump",
+                        "type": "slow_op"})["result"]
+    assert len(out["events"]) == 1
+    flight.snapshot("manual")
+    out = asok.execute({"prefix": "events reset"})["result"]
+    assert out["cleared"] == 2
+    out = asok.execute({"prefix": "events snapshots"})["result"]
+    assert len(out) == 1 and out[0]["reason"] == "manual"
+
+
+def test_flight_config_knobs_hot_apply_and_replay():
+    cfg = Config()
+    flight.register_config(cfg)
+    cfg.set("flight_ring_capacity", 16)
+    assert flight.status()["capacity"] == 16
+    cfg.set("flight_enabled", False)
+    assert flight.record("tick", "e") is None
+    cfg.set("flight_enabled", True)
+    assert flight.record("tick", "e") is not None
+    # replay: a second daemon registering in the same process must pick
+    # up knobs the first one's operator already turned — and the knob
+    # turns themselves are config_change flight events
+    cfg2 = Config()
+    flight.register_config(cfg2)
+    cfg2.set("flight_ring_capacity", 32)
+    flight.register_config(cfg2)     # idempotent + replays the diff
+    assert flight.status()["capacity"] == 32
+    changes = flight.dump("config_change")["events"]
+    assert any(e["entity"] == "flight_ring_capacity"
+               and e["detail"]["new"] == 32 for e in changes)
+
+
+def test_capacity_floor_is_enforced():
+    flight.configure(capacity=1)
+    assert flight.status()["capacity"] == 8
+
+
+def test_fault_injection_decisions_are_flight_events():
+    from ceph_tpu.qa import faultinject
+    faultinject.reset(seed=7)
+    faultinject.arm_device_failures(1)
+    faultinject.set_enabled(True)
+    try:
+        assert faultinject.should_fail_device() is True
+    finally:
+        faultinject.set_enabled(False)
+        faultinject.reset()
+    evs = flight.dump("fault_injected")["events"]
+    assert len(evs) == 1 and evs[0]["entity"] == "device_oneshot"
+    assert evs[0]["detail"]["action"] == "fail"

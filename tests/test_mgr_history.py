@@ -1,0 +1,344 @@
+"""Metrics history: sample rings, windowed math vs exact oracles,
+reset detection, mgr fan-in of shipped flight rings, and the query
+surfaces (`perf history`, `timeline dump`)."""
+from __future__ import annotations
+
+import math
+import time
+
+import pytest
+
+from ceph_tpu.mgr.daemon import DaemonStateIndex, MgrDaemon
+from ceph_tpu.mgr.exporter import sparkline
+from ceph_tpu.mgr.history import (MetricsHistory, _bucket_counts,
+                                  bucket_quantile_ms)
+from ceph_tpu.utils import flight
+
+
+@pytest.fixture(autouse=True)
+def clean_flight():
+    flight.reset()
+    yield
+    flight.reset()
+    flight.clear_snapshots()
+
+
+# -- bucket math vs exact oracle ----------------------------------------------
+
+def _to_buckets(latencies_us: list[int]) -> dict[int, int]:
+    out: dict[int, int] = {}
+    for us in latencies_us:
+        exp = max(0, int(math.floor(math.log2(us))))
+        out[exp] = out.get(exp, 0) + 1
+    return out
+
+
+@pytest.mark.parametrize("q", [0.5, 0.9, 0.99])
+def test_bucket_quantile_matches_exact_oracle(q):
+    # deterministic skewed sample: many fast ops, a slow tail
+    lats = [50 + 7 * i for i in range(90)] + \
+        [20_000 + 900 * i for i in range(10)]
+    buckets = _to_buckets(lats)
+    got = bucket_quantile_ms(buckets, q)
+    # oracle: the exact q-quantile element's bucket upper bound
+    exact = sorted(lats)[min(len(lats) - 1,
+                             math.ceil(q * len(lats)) - 1)]
+    want = round(2 ** (math.floor(math.log2(exact)) + 1) / 1e3, 3)
+    assert got == want
+    # the quoted bound brackets the exact value within one power of two
+    assert exact / 1e3 <= got <= 2 * exact / 1e3
+
+
+def test_bucket_quantile_empty_and_tail():
+    assert bucket_quantile_ms({}, 0.99) == 0.0
+    # all mass below the want threshold until the last bucket
+    assert bucket_quantile_ms({10: 1}, 0.5) == round(2 ** 11 / 1e3, 3)
+
+
+def test_bucket_counts_normalizes_key_styles():
+    raw = {"buckets": {"2^12": 3, 5: 2, "5": 1, "junk": 9}}
+    assert _bucket_counts(raw) == {12: 3, 5: 3}
+    assert _bucket_counts({}) == {}
+
+
+# -- sample rings -------------------------------------------------------------
+
+def test_history_ring_evicts_past_slots():
+    h = MetricsHistory(slots=5, interval_s=0.0)
+    for i in range(12):
+        h.maybe_sample("osd.0", {"ops": i}, {}, now=float(i))
+    samples = h.series("ops")["osd.0"]
+    assert len(samples) == 5
+    assert [v for _t, v in samples] == [7, 8, 9, 10, 11]
+    # shrinking slots trims live rings
+    h.configure(slots=3)
+    assert len(h.series("ops")["osd.0"]) == 3
+
+
+def test_cadence_gate_skips_early_samples():
+    h = MetricsHistory(interval_s=10.0)
+    assert h.maybe_sample("osd.0", {"ops": 1}, {}, now=100.0) is True
+    assert h.maybe_sample("osd.0", {"ops": 2}, {}, now=101.0) is False
+    assert h.maybe_sample("osd.0", {"ops": 3}, {}, now=110.0) is True
+    assert [v for _t, v in h.series("ops")["osd.0"]] == [1, 3]
+
+
+def test_max_series_overflow_counted_not_stored():
+    h = MetricsHistory(interval_s=0.0, max_series=2)
+    h.maybe_sample("osd.0", {"a": 1, "b": 2, "c": 3, "d": 4}, {},
+                   now=0.0)
+    assert h.status()["series"] == 2
+    assert h.status()["series_dropped"] == 2
+
+
+def test_counter_moving_backwards_drops_daemon_history():
+    h = MetricsHistory(interval_s=0.0)
+    h.maybe_sample("osd.0", {"ops": 100, "bytes": 5000}, {}, now=0.0)
+    h.maybe_sample("osd.0", {"ops": 150, "bytes": 9000}, {}, now=1.0)
+    # daemon-side `perf reset`: cumulative state restarts near zero
+    h.maybe_sample("osd.0", {"ops": 3, "bytes": 40}, {}, now=2.0)
+    assert h.resets_detected == 1
+    # pre-reset history is gone; sampling continues from fresh state
+    ops = h.series("ops")["osd.0"]
+    assert [v for _t, v in ops] == [3]
+    q = h.query("ops", window_s=60.0, now=2.0)
+    assert q["daemons"]["osd.0"]["samples"] == 1
+
+
+def test_gauge_never_counts_as_reset():
+    h = MetricsHistory(interval_s=0.0)
+    schema = {"depth": {"type": "gauge"}}
+    for now, v in ((0.0, 9), (1.0, 2), (2.0, 7)):
+        h.maybe_sample("osd.0", {"depth": v}, schema, now=now)
+    assert h.resets_detected == 0
+    entry = h.query("depth", window_s=60.0, now=2.0)["daemons"]["osd.0"]
+    assert entry["last"] == 7 and entry["min"] == 2 and entry["max"] == 9
+    assert "rate_per_s" not in entry     # non-monotonic: not a counter
+
+
+# -- windowed query math ------------------------------------------------------
+
+def test_counter_rate_over_window():
+    h = MetricsHistory(interval_s=0.0)
+    for now, v in ((0.0, 0), (5.0, 50), (10.0, 100)):
+        h.maybe_sample("osd.0", {"ops": v}, {}, now=now)
+    entry = h.query("ops", window_s=60.0, now=10.0)["daemons"]["osd.0"]
+    assert entry["rate_per_s"] == 10.0
+    # clipping the window to the last sample pair changes the base
+    entry = h.query("ops", window_s=6.0, now=10.0)["daemons"]["osd.0"]
+    assert entry["samples"] == 2 and entry["rate_per_s"] == 10.0
+
+
+def test_histogram_window_p99_is_newest_minus_oldest():
+    h = MetricsHistory(interval_s=0.0)
+    # cumulative buckets: by t=1 everything is fast (exp 6); the window
+    # t=1..2 adds 10 fast + 90 slow (exp 14) events
+    h.maybe_sample("osd.0",
+                   {"lat": {"count": 100, "sum": 1.0,
+                            "buckets": {"2^6": 100}}},
+                   {"lat": {"type": "histogram"}}, now=1.0)
+    h.maybe_sample("osd.0",
+                   {"lat": {"count": 200, "sum": 9.0,
+                            "buckets": {"2^6": 110, "2^14": 90}}},
+                   {"lat": {"type": "histogram"}}, now=2.0)
+    entry = h.query("lat", window_s=60.0, now=2.0)["daemons"]["osd.0"]
+    assert entry["count"] == 100 and entry["rate_per_s"] == 100.0
+    # window distribution is the delta: 10 @ 2^6, 90 @ 2^14
+    assert entry["p99_ms"] == round(2 ** 15 / 1e3, 3)
+    assert entry["p50_ms"] == round(2 ** 15 / 1e3, 3)
+
+
+def test_avg_counter_window_math():
+    h = MetricsHistory(interval_s=0.0)
+    for now, n, s in ((0.0, 10, 5.0), (10.0, 110, 55.0)):
+        h.maybe_sample("osd.0", {"commit": {"avgcount": n, "sum": s}},
+                       {"commit": {"type": "avg"}}, now=now)
+    entry = h.query("commit", window_s=60.0,
+                    now=10.0)["daemons"]["osd.0"]
+    assert entry["count"] == 100
+    assert entry["rate_per_s"] == 10.0
+    assert entry["avg"] == 0.5
+
+
+def test_drop_and_reset():
+    h = MetricsHistory(interval_s=0.0)
+    h.maybe_sample("osd.0", {"ops": 1}, {}, now=0.0)
+    h.maybe_sample("osd.1", {"ops": 1}, {}, now=0.0)
+    assert h.drop("osd.0") == 1
+    assert h.daemons() == ["osd.1"]
+    assert h.reset() == 1
+    assert h.daemons() == []
+
+
+def test_sparkline_data_and_rendering():
+    h = MetricsHistory(interval_s=0.0)
+    now = time.monotonic()
+    for i in range(6):
+        h.maybe_sample("osd.0", {"ops": i * 10}, {}, now=now - 6 + i)
+    rows = h.sparkline_data(limit=5)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["daemon"] == "osd.0" and row["metric"] == "ops"
+    # cumulative counter renders as per-interval rates (all ~10/s)
+    assert all(abs(p - 10.0) < 0.5 for p in row["points"])
+    text = sparkline(row["points"])
+    assert len(text) == len(row["points"]) and text.strip()
+    assert sparkline([]) == ""
+
+
+# -- DaemonStateIndex fan-in --------------------------------------------------
+
+def _payload(name, counters, events=None, schema=None):
+    p = {"daemon_name": name, "service": "osd", "counters": counters}
+    if schema is not None:
+        p["schema"] = schema
+    if events is not None:
+        p["events"] = events
+    return p
+
+
+def test_report_feeds_history_and_flight_sources():
+    idx = DaemonStateIndex()
+    idx.history.configure(interval_s=0.05)
+    ring = {"pid": 7, "boot": "7.aa", "mono_now": 100.0,
+            "wall_now": 1e9,
+            "events": [{"seq": 1, "mono": 90.0, "wall": 1e9 - 10,
+                        "type": "slow_op", "entity": "osd.0",
+                        "detail": {}}]}
+    idx.report(_payload("osd.0", {"ops": 1}, events=ring, schema={}))
+    assert idx.history.daemons() == ["osd.0"]
+    assert (7, "7.aa") in idx.flight_sources
+    assert len(idx.flight_rings()) == 1
+    assert idx.flight_rings()[0]["events"][0]["type"] == "slow_op"
+
+
+def test_ingest_events_dedups_by_seq_per_source():
+    idx = DaemonStateIndex()
+    ev1 = {"seq": 1, "mono": 1.0, "wall": 1.0, "type": "a",
+           "entity": "", "detail": {}}
+    ev2 = {"seq": 2, "mono": 2.0, "wall": 2.0, "type": "b",
+           "entity": "", "detail": {}}
+    ring = {"pid": 7, "boot": "7.aa", "mono_now": 10.0, "wall_now": 10.0,
+            "events": [ev1, ev2]}
+    assert idx.ingest_events(ring) == 2
+    # the same ring again through a co-located daemon's report: no dups
+    assert idx.ingest_events(dict(ring)) == 0
+    # a fresh tail past the cursor lands
+    ring3 = dict(ring, events=[ev2, dict(ev2, seq=3, type="c")])
+    assert idx.ingest_events(ring3) == 1
+    src = idx.flight_sources[(7, "7.aa")]
+    assert [e["type"] for e in src["events"]] == ["a", "b", "c"]
+    # a RESPAWNED worker reuses the pid but carries a new boot token:
+    # its ring is a separate source, seq restarting at 1 is fine
+    assert idx.ingest_events({"pid": 7, "boot": "7.bb",
+                              "mono_now": 1.0, "wall_now": 1.0,
+                              "events": [dict(ev1, type="reborn")]}) == 1
+    assert len(idx.flight_sources) == 2
+
+
+def test_flight_source_bounds_events_and_rotates_sources():
+    idx = DaemonStateIndex()
+    idx.FLIGHT_SOURCE_EVENTS = 5
+    idx.MAX_FLIGHT_SOURCES = 2
+    events = [{"seq": i, "mono": float(i), "wall": float(i),
+               "type": "t", "entity": "", "detail": {}}
+              for i in range(1, 20)]
+    idx.ingest_events({"pid": 1, "boot": "a", "mono_now": 0.0,
+                       "wall_now": 0.0, "events": events})
+    src = idx.flight_sources[(1, "a")]
+    assert len(src["events"]) == 5 and src["max_seq"] == 19
+    for pid in (2, 3):
+        idx.ingest_events({"pid": pid, "boot": str(pid),
+                           "mono_now": 0.0, "wall_now": 0.0,
+                           "events": []})
+    assert len(idx.flight_sources) == 2
+    assert (1, "a") not in idx.flight_sources   # oldest update evicted
+
+
+def test_ingest_rejects_malformed_rings():
+    idx = DaemonStateIndex()
+    assert idx.ingest_events({}) == 0
+    assert idx.ingest_events({"pid": 1, "boot": "a",
+                              "mono_now": "junk", "wall_now": 0}) == 0
+    assert idx.ingest_events({"pid": 1, "boot": "a", "mono_now": 0.0,
+                              "wall_now": 0.0,
+                              "events": [None, {"seq": "x"}]}) == 0
+    assert idx.flight_sources != {}     # well-formed header did land
+
+
+def test_cull_drops_history_but_keeps_flight_sources():
+    idx = DaemonStateIndex(stale_after=0.0)
+    idx.history.configure(interval_s=0.0)
+    idx.report(_payload("osd.0", {"ops": 1}, schema={}, events={
+        "pid": 1, "boot": "a", "mono_now": 0.0, "wall_now": 0.0,
+        "events": [{"seq": 1, "mono": 0.0, "wall": 0.0, "type": "t",
+                    "entity": "", "detail": {}}]}))
+    time.sleep(0.01)
+    assert idx.cull() == ["osd.0"]
+    assert idx.history.daemons() == []
+    # the flight ring is the post-mortem record of exactly such deaths
+    assert len(idx.flight_rings()) == 1
+
+
+# -- MgrDaemon surfaces (no cluster boot needed) ------------------------------
+
+@pytest.fixture
+def mgr(tmp_path):
+    m = MgrDaemon([("127.0.0.1", 1)], modules=[], exporter_port=None,
+                  admin_socket_path=str(tmp_path / "mgr.asok"))
+    yield m
+
+
+def test_perf_history_query_and_listing(mgr):
+    mgr.daemon_index.history.configure(interval_s=0.0)
+    for now, v in ((0.0, 0), (10.0, 100)):
+        mgr.daemon_index.history.maybe_sample(
+            "osd.0", {"ops": v}, {}, now=now)
+    listing = mgr.perf_history(None)
+    assert listing["metrics"] == ["ops"]
+    assert listing["daemons"] == ["osd.0"]
+    q = mgr.perf_history("ops", window_s=1e9)
+    assert q["daemons"]["osd.0"]["rate_per_s"] == 10.0
+    # the asok verb goes through the same path
+    out = mgr.asok.execute({"prefix": "perf history", "metric": "ops",
+                            "window": 1e9})["result"]
+    assert out["daemons"]["osd.0"]["rate_per_s"] == 10.0
+    st = mgr.asok.execute({"prefix": "history status"})["result"]
+    assert st["series"] == 1
+
+
+def test_mgr_history_knobs_reconfigure_live_store(mgr):
+    mgr.config.set("mgr_history_slots", 7)
+    mgr.config.set("mgr_history_interval_s", 0.25)
+    mgr.config.set("mgr_history_max_series", 9)
+    st = mgr.daemon_index.history.status()
+    assert st["slots"] == 7
+    assert st["interval_s"] == 0.25
+    assert st["max_series"] == 9
+
+
+def test_timeline_dump_merges_reported_local_and_extra_rings(mgr):
+    # a shipped ring from another OS process
+    mgr.daemon_index.ingest_events({
+        "pid": 7, "boot": "7.aa", "mono_now": time.monotonic(),
+        "wall_now": time.time(),
+        "events": [{"seq": 1, "mono": time.monotonic() - 2.0,
+                    "wall": 0.0, "type": "worker_death",
+                    "entity": "shard1", "detail": {}}]})
+    # the mgr's own process ring
+    flight.record("osd_markdown", "osd.2")
+    # a ring the caller fetched itself (control-channel path)
+    extra = {"pid": 9, "boot": "9.bb", "mono_now": time.monotonic(),
+             "wall_now": time.time(),
+             "events": [{"seq": 1, "mono": time.monotonic() - 1.0,
+                         "wall": 0.0, "type": "breaker_trip",
+                         "entity": "tpu:0", "detail": {}}]}
+    tl = mgr.timeline_dump(extra_rings=[extra])
+    types = [e["type"] for e in tl["events"]]
+    assert types == ["worker_death", "breaker_trip", "osd_markdown"]
+    assert tl["sources"] == 3
+    assert len(tl["processes"]) == 3
+    # windowed dump clips the older tail
+    tl = mgr.timeline_dump(extra_rings=[extra], window_s=1.5)
+    assert [e["type"] for e in tl["events"]] == \
+        ["breaker_trip", "osd_markdown"]

@@ -50,6 +50,8 @@ def lint(path, rules):
      "decl_use_clients_good.py"),
     ("decl-use", "decl_use_pipeline_bad.py", 2,
      "decl_use_pipeline_good.py"),
+    ("decl-use", "decl_use_flight_bad.py", 2,
+     "decl_use_flight_good.py"),
     ("report-export-consistency", "report_export_bad.py", 1,
      "report_export_good.py"),
     ("view-escape", "view_escape_pos.py", 5, "view_escape_neg.py"),
