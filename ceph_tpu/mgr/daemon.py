@@ -32,6 +32,7 @@ is Python).
 from __future__ import annotations
 
 import asyncio
+import collections
 import time
 
 from ceph_tpu.crush.osdmap import Incremental, OSDMap, PG
@@ -42,8 +43,9 @@ from ceph_tpu.mon.mon_client import MonClient
 from ceph_tpu.msg.messages import (Message, MMgrConfigure, MMgrOpen,
                                    MMgrReport)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger
-from ceph_tpu.utils import flight
+from ceph_tpu.utils import critpath, flight, tracer
 from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.perf_counters import pow2_bucket
 
 import json
 
@@ -106,6 +108,9 @@ class DaemonStateIndex:
         # entry per reporting OS process, deduped by seq (co-located
         # daemons ship the same process ring)
         self.flight_sources: dict[tuple, dict] = {}
+        # cross-process trace assembly (tracing v2): spans shipped on
+        # the report leg keyed by trace_id, (pid, boot, seq)-deduped
+        self.traces = TraceIndex()
 
     def open(self, name: str, service: str) -> DaemonState:
         st = self.daemons.get(name)
@@ -146,6 +151,9 @@ class DaemonStateIndex:
         ev = payload.get("events")
         if isinstance(ev, dict):
             self.ingest_events(ev)
+        ts = payload.get("trace_spans")
+        if isinstance(ts, dict):
+            self.traces.ingest(ts)
         return st
 
     def ingest_events(self, ring: dict) -> int:
@@ -285,6 +293,201 @@ class DaemonStateIndex:
                 for name, st in sorted(self.daemons.items())}
 
 
+class TraceIndex:
+    """Cluster-wide trace assembly (tracing v2).
+
+    Spans shipped on the MMgrReport leg — each envelope stamped with
+    the sending process's (pid, boot) and a per-process monotonic seq —
+    are keyed here by trace_id. Co-located daemons ship the same
+    process collector, so ingest dedups on (pid, boot, seq) exactly
+    like the flight-ring fan-in. Span *links* (an offload batch span
+    linking every rider op's trace) are indexed in reverse so
+    assembling a rider's trace pulls the shared batch span in.
+
+    Attribution: once a trace goes quiet (`SETTLE_S` without new
+    spans), its critical path is computed ONCE and banked into
+    per-(op_class, stage) and per-(client, stage) power-of-two
+    histograms — the `ceph_trace_critical_path_us` export. Stragglers
+    arriving later still show in `trace get`, but never double-bank."""
+
+    MAX_TRACES = 512            # mgr_max_traces overrides
+    MAX_SPANS_PER_TRACE = 256
+    SETTLE_S = 0.5              # quiet time before a trace attributes
+    HIST_BUCKETS = 40           # pow2 µs buckets (2^40 us ≈ 13 days)
+
+    def __init__(self, max_traces: int | None = None):
+        self.max_traces = max_traces or self.MAX_TRACES
+        #: trace_id -> {"spans": [dict], "ids": {(boot, seq)},
+        #:  "updated": mono, "cp": dict|None, "banked": bool}
+        self.traces: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        #: (pid, boot) -> max seq ingested (the dedup cursor)
+        self.sources: dict[tuple, int] = {}
+        #: target trace_id -> spans (owned by OTHER traces) linking it
+        self.link_map: dict[str, list[dict]] = {}
+        #: (op_class, stage) / (client, stage) -> pow2 histogram
+        self.class_hists: dict[tuple, dict] = {}
+        self.client_hists: dict[tuple, dict] = {}
+        #: op_class -> slowest settled trace (exporter exemplars)
+        self.exemplars: dict[str, dict] = {}
+        self.banked_traces = 0
+
+    def configure(self, max_traces: int | None = None) -> None:
+        if max_traces:
+            self.max_traces = max(int(max_traces), 4)
+            self._evict()
+
+    def _evict(self) -> None:
+        while len(self.traces) > self.max_traces:
+            tid, _ = self.traces.popitem(last=False)
+            self.link_map.pop(tid, None)
+
+    def ingest(self, envelope: dict) -> int:
+        """Merge one shipped span batch; returns NEW spans stored."""
+        try:
+            pid = int(envelope.get("pid") or 0)
+            boot = str(envelope.get("boot") or pid)
+            spans = envelope.get("spans") or []
+        except (TypeError, ValueError):
+            return 0
+        src = (pid, boot)
+        max_seq = self.sources.get(src, 0)
+        added = 0
+        now = time.monotonic()
+        for s in spans:
+            if not isinstance(s, dict):
+                continue
+            seq = s.get("seq")
+            if not isinstance(seq, int) or seq <= max_seq:
+                continue        # dup from a co-located daemon's report
+            max_seq = seq
+            tid = s.get("trace_id")
+            if not tid:
+                continue
+            s = dict(s, pid=pid, boot=boot)
+            e = self.traces.get(tid)
+            if e is None:
+                e = self.traces[tid] = {"spans": [], "ids": set(),
+                                        "updated": now, "cp": None,
+                                        "banked": False}
+            else:
+                self.traces.move_to_end(tid)
+            key = (boot, seq)
+            if key in e["ids"]:
+                continue
+            e["ids"].add(key)
+            e["spans"].append(s)
+            del e["spans"][:-self.MAX_SPANS_PER_TRACE]
+            e["updated"] = now
+            e["cp"] = None      # re-render on next access
+            added += 1
+            for l in s.get("links") or ():
+                lt = l.get("trace_id")
+                if lt and lt != tid:
+                    self.link_map.setdefault(lt, []).append(s)
+        self.sources[src] = max_seq
+        self._evict()
+        return added
+
+    def assembled(self, trace_id: str) -> list[dict]:
+        """All spans of one trace: its own plus spans from other
+        traces that LINK it (deduped by span identity)."""
+        e = self.traces.get(trace_id)
+        own = list(e["spans"]) if e else []
+        seen = {s.get("span_id") for s in own}
+        for s in self.link_map.get(trace_id, ()):
+            if s.get("span_id") not in seen:
+                seen.add(s.get("span_id"))
+                own.append(s)
+        return own
+
+    def _hist_add(self, hists: dict, key: tuple, us: float) -> None:
+        h = hists.get(key)
+        if h is None:
+            h = hists[key] = {"buckets": [0] * self.HIST_BUCKETS,
+                              "sum": 0.0, "count": 0}
+        if us > 0.0:
+            b = min(pow2_bucket(us), self.HIST_BUCKETS - 1)
+            h["buckets"][b] += 1
+        h["sum"] += us
+        h["count"] += 1
+
+    def settle(self) -> int:
+        """Bank critical-path attribution for traces that went quiet;
+        idempotent per trace. Returns traces banked this call."""
+        now = time.monotonic()
+        banked = 0
+        for tid, e in list(self.traces.items()):
+            if e["banked"] or now - e["updated"] < self.SETTLE_S:
+                continue
+            cp = self.critical_path(tid)
+            if cp is None or cp["total_us"] <= 0.0:
+                continue
+            e["banked"] = True
+            self.banked_traces += 1
+            banked += 1
+            for stage, us in cp["stages"].items():
+                self._hist_add(self.class_hists,
+                               (cp["op_class"], stage), us)
+                if cp["client"]:
+                    self._hist_add(self.client_hists,
+                                   (cp["client"], stage), us)
+            ex = self.exemplars.get(cp["op_class"])
+            if ex is None or cp["total_us"] >= ex["total_us"]:
+                self.exemplars[cp["op_class"]] = {
+                    "trace_id": tid, "total_us": cp["total_us"],
+                    "top_stage": cp["top_stage"]}
+        return banked
+
+    def critical_path(self, trace_id: str) -> dict | None:
+        """Cached per-trace attribution (recomputed after new spans)."""
+        e = self.traces.get(trace_id)
+        if e is None:
+            return None
+        if e["cp"] is None:
+            e["cp"] = critpath.critical_path(self.assembled(trace_id))
+        return e["cp"]
+
+    def get(self, trace_id: str) -> dict | None:
+        """`trace get <id>`: the assembled multi-process waterfall."""
+        spans = self.assembled(trace_id)
+        if not spans:
+            return None
+        cp = self.critical_path(trace_id)
+        return {"trace_id": trace_id,
+                "num_spans": len(spans),
+                "processes": sorted({f"{s.get('pid')}:{s.get('boot')}"
+                                     for s in spans}),
+                "critical_path": cp,
+                "waterfall": critpath.waterfall(spans)}
+
+    def slowest(self, n: int = 10,
+                op_class: str | None = None) -> list[dict]:
+        """`trace slowest [n] [--class]`: settled traces by root
+        total, the dashboard table feed."""
+        self.settle()
+        out = []
+        for tid in self.traces:
+            cp = self.critical_path(tid)
+            if cp is None or cp["total_us"] <= 0.0:
+                continue
+            if op_class and cp["op_class"] != op_class:
+                continue
+            out.append({"trace_id": tid, "total_us": cp["total_us"],
+                        "op_class": cp["op_class"],
+                        "client": cp["client"],
+                        "top_stage": cp["top_stage"],
+                        "stages": cp["stages"]})
+        out.sort(key=lambda t: -t["total_us"])
+        return out[:max(n, 1)]
+
+    def status(self) -> dict:
+        return {"traces": len(self.traces),
+                "sources": len(self.sources),
+                "banked": self.banked_traces,
+                "max_traces": self.max_traces}
+
+
 class MgrModule:
     """Module contract: tick(mgr) runs every mgr interval."""
 
@@ -328,7 +531,11 @@ class MgrDaemon(Dispatcher):
                    MetricsHistory.DEFAULT_MAX_SERIES,
                    "total (daemon, metric) history series cap — the "
                    "global memory bound; overflow series are counted "
-                   "and skipped", minimum=1)]
+                   "and skipped", minimum=1),
+            Option("mgr_max_traces", "int", TraceIndex.MAX_TRACES,
+                   "assembled traces retained in the TraceIndex "
+                   "(LRU past the cap — the trace-assembly memory "
+                   "bound)", minimum=4)]
         self.config = config if config is not None else Config([
             Option("mgr_max_client_series", "int", 64,
                    "cap on distinct ceph_client label values in "
@@ -362,6 +569,12 @@ class MgrDaemon(Dispatcher):
         self.config.add_observer(
             ("mgr_history_slots", "mgr_history_interval_s",
              "mgr_history_max_series"), _on_history_knob)
+        self.daemon_index.traces.configure(
+            max_traces=self.config.get("mgr_max_traces"))
+        self.config.add_observer(
+            ("mgr_max_traces",),
+            lambda _n, v: self.daemon_index.traces.configure(
+                max_traces=v))
         self.asok = None
         if admin_socket_path:
             from ceph_tpu.utils.admin_socket import AdminSocket
@@ -384,6 +597,16 @@ class MgrDaemon(Dispatcher):
                 "history status",
                 lambda req: self.daemon_index.history.status(),
                 "metrics-history store: series/caps/resets")
+            self.asok.register_command(
+                "trace get",
+                lambda req: self.trace_get(req.get("id", "")),
+                "one assembled multi-process trace: id=<trace_id> -> "
+                "waterfall + critical-path stage attribution")
+            self.asok.register_command(
+                "trace slowest",
+                lambda req: self.trace_slowest(
+                    int(req.get("n", 10)), req.get("class")),
+                "slowest assembled traces: [n=10] [class=<op class>]")
         self.addr: tuple[str, int] | None = None
         # True while the mgrmap names us active; standbys keep their
         # (empty) digest to themselves so they can never overwrite the
@@ -424,6 +647,14 @@ class MgrDaemon(Dispatcher):
                 # for counters), rendered as unicode microcharts
                 status["history_sparklines"] = \
                     self.daemon_index.history.sparkline_data()
+                # slowest assembled traces (tracing v2) with their
+                # critical-path top stage for the dashboard table
+                try:
+                    self._ingest_local_traces()
+                    status["slow_traces"] = \
+                        self.daemon_index.traces.slowest(10)
+                except Exception:
+                    status["slow_traces"] = []
                 return status
             self.exporter = MetricsExporter(
                 port=self._exporter_port, health_cb=health_cb,
@@ -485,6 +716,34 @@ class MgrDaemon(Dispatcher):
         return {"events": events,
                 "processes": sorted({e["boot"] for e in events}),
                 "sources": len(rings)}
+
+    def _ingest_local_traces(self) -> None:
+        """Fold the mgr's OWN process span collector into the index:
+        a co-located client's rados_op root (or a mon/mgr span) has no
+        MgrClient leg of its own, yet belongs in the assembly. The
+        TraceIndex (pid, boot, seq) cursor makes the repeated full
+        export idempotent."""
+        try:
+            self.daemon_index.traces.ingest(
+                tracer.collector().export_since(0, limit=1 << 14))
+        except Exception:
+            pass
+
+    def trace_get(self, trace_id: str) -> dict:
+        """`trace get <id>`: one assembled multi-process waterfall."""
+        self._ingest_local_traces()
+        got = self.daemon_index.traces.get(str(trace_id))
+        if got is None:
+            return {"error": f"trace {trace_id!r} not assembled",
+                    "index": self.daemon_index.traces.status()}
+        return got
+
+    def trace_slowest(self, n: int = 10,
+                      op_class: str | None = None) -> dict:
+        """`trace slowest [n] [--class]`: settled traces by duration."""
+        self._ingest_local_traces()
+        return {"traces": self.daemon_index.traces.slowest(n, op_class),
+                "index": self.daemon_index.traces.status()}
 
     def _on_osdmap(self, payload: dict) -> None:
         from ceph_tpu.crush.osdmap import apply_map_payload

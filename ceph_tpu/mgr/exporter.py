@@ -212,6 +212,53 @@ def render_metrics(health: dict | None = None, index=None,
                 f'{ev.get("progress", 0.0)}')
         if not prog["rows"]:
             del families["ceph_progress_fraction"]
+    # critical-path attribution families (tracing v2): per-class and
+    # per-client stage histograms banked by the TraceIndex as assembled
+    # traces settle, plus exemplar series tying the existing latency
+    # histograms to concrete slow trace_ids (separate series, not
+    # OpenMetrics bucket suffixes — the text-format 0.0.4 parse of
+    # bucket lines stays intact). Rendered whenever the index carries
+    # traces, even before the first daemon report lands.
+    tix = getattr(index, "traces", None)
+    if tix is not None:
+        tix.settle()
+        for metric, hists, lname in (
+                ("ceph_trace_critical_path_us", tix.class_hists,
+                 "op_class"),
+                ("ceph_trace_client_critical_path_us",
+                 tix.client_hists, "ceph_client")):
+            if not hists:
+                continue
+            fam = families.setdefault(
+                metric, {"type": "histogram", "rows": []})
+            for (key, stage), h in sorted(hists.items()):
+                label = (f'{lname}="{_label_escape(str(key))}",'
+                         f'stage="{_label_escape(str(stage))}"')
+                cum = 0
+                for exp, n in enumerate(h["buckets"]):
+                    if not n:
+                        continue
+                    cum += n
+                    fam["rows"].append(
+                        f'{metric}_bucket{{{label},'
+                        f'le="{2 ** (exp + 1)}"}} {cum}')
+                fam["rows"].append(
+                    f'{metric}_bucket{{{label},le="+Inf"}} '
+                    f'{h["count"]}')
+                fam["rows"].append(
+                    f'{metric}_sum{{{label}}} {round(h["sum"], 1)}')
+                fam["rows"].append(
+                    f'{metric}_count{{{label}}} {h["count"]}')
+        if tix.exemplars:
+            fam = families.setdefault("ceph_op_total_us_exemplar",
+                                      {"type": "gauge", "rows": []})
+            for op_class, ex in sorted(tix.exemplars.items()):
+                fam["rows"].append(
+                    f'ceph_op_total_us_exemplar'
+                    f'{{op_class="{_label_escape(str(op_class))}",'
+                    f'trace_id="{_label_escape(str(ex["trace_id"]))}",'
+                    f'top_stage="{_label_escape(str(ex["top_stage"]))}"'
+                    f'}} {ex["total_us"]}')
     out: list[str] = []
     for metric in sorted(families):
         out.append(f"# TYPE {metric} {families[metric]['type']}")
@@ -342,6 +389,28 @@ def render_dashboard(status: dict, health: dict | None) -> str:
                    "<th>metric</th><th>trend</th><th>last</th></tr>"
                    + "".join(spark_rows) + "</table>"
                    if spark_rows else "")
+    # slowest assembled traces (tracing v2: cluster-wide TraceIndex
+    # with critical-path stage attribution per trace)
+    slow_rows = []
+    for t in (status.get("slow_traces") or [])[:10]:
+        if not isinstance(t, dict):
+            continue
+        stages = t.get("stages") or {}
+        breakdown = " ".join(
+            f"{k}:{v / 1000:.1f}" for k, v in stages.items()
+            if isinstance(v, (int, float)) and v > 0)
+        slow_rows.append(
+            f"<tr><td>{esc(str(t.get('trace_id', '')))}</td>"
+            f"<td>{esc(str(t.get('op_class', '')))}</td>"
+            f"<td>{esc(str(t.get('client', '')))}</td>"
+            f"<td>{float(t.get('total_us', 0)) / 1000:.2f}</td>"
+            f"<td>{esc(str(t.get('top_stage', '')))}</td>"
+            f"<td>{esc(breakdown)}</td></tr>")
+    slow_html = ("<h2>slowest traces</h2><table><tr><th>trace</th>"
+                 "<th>class</th><th>client</th><th>ms</th>"
+                 "<th>top stage</th><th>stage ms</th></tr>"
+                 + "".join(slow_rows) + "</table>"
+                 if slow_rows else "")
     # recent traces (process-wide span collector; empty when tracing off)
     trace_rows = []
     for t in tracer.recent_traces(limit=15):
@@ -375,6 +444,7 @@ mons {', '.join(str(q) for q in
 {clients_html}
 {sparks_html}
 {progress_html}
+{slow_html}
 {traces_html}
 <h2>mgr modules</h2><pre>{mods}</pre>
 <p><a href="/metrics">metrics</a> &middot;

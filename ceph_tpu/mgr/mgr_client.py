@@ -29,7 +29,7 @@ from typing import Awaitable, Callable
 
 from ceph_tpu.msg.messages import Message, MMgrConfigure, MMgrOpen, MMgrReport
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
-from ceph_tpu.utils import flight
+from ceph_tpu.utils import flight, tracer
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import PerfCountersCollection
 
@@ -82,6 +82,10 @@ class MgrClient(Dispatcher):
         # co-located daemons each ship it — the mgr dedups by
         # (boot, seq))
         self._flight_cursor = 0
+        # tracer span-collector shipping cursor (tracing v2): completed
+        # sampled/promoted spans travel incrementally the same way, and
+        # the mgr's TraceIndex dedups by (pid, boot, seq)
+        self._trace_cursor = 0
         self._task: asyncio.Task | None = None
 
     # -- lifecycle -----------------------------------------------------------
@@ -137,6 +141,7 @@ class MgrClient(Dispatcher):
         self._schema_keys_sent = None
         self._last_sent = {}
         self._flight_cursor = 0
+        self._trace_cursor = 0
         return conn
 
     def _safe(self, cb, default):
@@ -190,9 +195,19 @@ class MgrClient(Dispatcher):
         # cursor advances only after the send below cannot fail
         ring = flight.events_since(self._flight_cursor)
         payload["events"] = ring
+        # trace assembly leg: completed sampled/tail-promoted spans
+        # since the last report (bounded batch; the cursor advances
+        # only past what actually travelled, so the rest follows next
+        # period). Process-wide like the flight ring — co-located
+        # daemons each ship it, the mgr dedups by (pid, boot, seq).
+        spans = tracer.export_since(self._trace_cursor)
+        if spans["spans"]:
+            payload["trace_spans"] = spans
         conn.send_message(MMgrReport(payload))
         if ring["events"]:
             self._flight_cursor = max(e["seq"] for e in ring["events"])
+        if spans["spans"]:
+            self._trace_cursor = spans["next"]
         self.reports_sent += 1
         return True
 

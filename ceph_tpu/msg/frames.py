@@ -89,27 +89,37 @@ def _seg_len(seg) -> int:
 
 # trace-context TLV segment (the Message.h otel_trace analog): an
 # OPTIONAL trailing frame segment `magic u16 | trace_id u64 | span_id
-# u64` stamped on MESSAGE frames when tracing is on. Peers that predate
-# it never send it, and receivers that don't know the magic drop it —
-# the op itself is untouched either way.
+# u64 [| flags u8]` stamped on MESSAGE frames when tracing is on. The
+# trailing flags byte (tracing v2) carries the head-sampling decision
+# so a trace is never half-sampled across processes; peers that
+# predate it sent the 18-byte form, which decodes with flags=0.
+# Receivers that don't know the magic drop the segment — the op itself
+# is untouched either way.
 TRACE_MAGIC = 0xEC7C
-_TRACE_SEG = struct.Struct("<HQQ")
+_TRACE_SEG = struct.Struct("<HQQ")        # legacy v1: magic, trace, span
+_TRACE_SEG_F = struct.Struct("<HQQB")     # v2: + sampling-flags byte
 
 
 def encode_trace_ctx(ctx: dict) -> bytes:
-    """Pack a tracer wire context ({"t": trace_id, "s": span_id})."""
-    return _TRACE_SEG.pack(TRACE_MAGIC, ctx["t"], ctx["s"])
+    """Pack a tracer wire context ({"t": trace, "s": span[, "f": flags]})."""
+    return _TRACE_SEG_F.pack(TRACE_MAGIC, ctx["t"], ctx["s"],
+                             int(ctx.get("f", 0) or 0) & 0xFF)
 
 
 def decode_trace_ctx(seg: bytes) -> dict | None:
     """Unpack a trace segment; None when it isn't one (unknown magic or
-    wrong size — forward/backward compatible by construction)."""
-    if len(seg) != _TRACE_SEG.size:
+    wrong size — forward/backward compatible by construction). Both the
+    18-byte v1 and 19-byte v2 forms are accepted."""
+    if len(seg) == _TRACE_SEG.size:
+        magic, trace_id, span_id = _TRACE_SEG.unpack(seg)
+        flags = 0
+    elif len(seg) == _TRACE_SEG_F.size:
+        magic, trace_id, span_id, flags = _TRACE_SEG_F.unpack(seg)
+    else:
         return None
-    magic, trace_id, span_id = _TRACE_SEG.unpack(seg)
     if magic != TRACE_MAGIC:
         return None
-    return {"t": trace_id, "s": span_id}
+    return {"t": trace_id, "s": span_id, "f": flags}
 
 
 def crc32c(data: bytes, seed: int = 0) -> int:

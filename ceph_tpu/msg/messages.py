@@ -225,7 +225,11 @@ def pack_batch(msgs: list) -> Message:
     for m in msgs:
         e = {"t": m.TYPE, "s": m.seq, "p": m.payload, "n": len(m.data)}
         if m.trace is not None:
-            e["tr"] = m.trace
+            # COPY the context: on the local-loopback path the entry
+            # dict is handed to the peer as-is, and an aliased inner
+            # dict would let either side's later mutation corrupt the
+            # other's trace identity (sampled flag included)
+            e["tr"] = dict(m.trace)
         entries.append(e)
         if len(m.data):
             # tx boundary (see encode_segments): checked unwrap of any
@@ -275,7 +279,8 @@ def unpack_batch(msg: Message) -> list:
             m = cls.__new__(cls)
             Message.__init__(m, e["p"], seg)
             m.seq = int(e["s"])
-            m.trace = e.get("tr")
+            tr = e.get("tr")
+            m.trace = dict(tr) if isinstance(tr, dict) else None
             out.append(m)
         except (KeyError, TypeError, ValueError):
             continue

@@ -273,17 +273,32 @@ class Connection:
         down transport (lossless replays, lossy drops on reset)."""
         if self._closed:
             return
-        if msg.trace is None and tracer.current_context() is not None:
-            # sending-end messenger span: the moment the message entered
-            # the transport, as a child of whatever op is running; its
-            # OWN id rides the wire so the receiving end nests under it
-            sp = tracer.start_span("ms_send", self.messenger.entity_name)
-            if sp is not None:
-                sp.set_tag("type", type(msg).__name__)
-                sp.set_tag("peer", self.peer_name or str(self.peer_addr))
-                sp.set_tag("bytes", len(msg.data))
-                msg.trace = sp.context()
-                sp.finish()
+        if msg.trace is None:
+            ctx = tracer.current_context()
+            if ctx is not None:
+                if ctx["f"] & tracer.FLAG_SAMPLED:
+                    # sending-end messenger span: the moment the message
+                    # entered the transport, as a child of whatever op is
+                    # running; its OWN id rides the wire so the receiving
+                    # end nests under it
+                    sp = tracer.start_span("ms_send",
+                                           self.messenger.entity_name)
+                    if sp is not None:
+                        sp.set_tag("type", type(msg).__name__)
+                        sp.set_tag("peer",
+                                   self.peer_name or str(self.peer_addr))
+                        sp.set_tag("bytes", len(msg.data))
+                        msg.trace = sp.context()
+                        sp.finish()
+                else:
+                    # unsampled (tail-retention regime): a per-message
+                    # span is ~1/4 of all spans on the hot path, and the
+                    # trace will most likely be discarded — stamp the
+                    # running op's own context on the wire instead. The
+                    # receive side nests directly under the op span, so
+                    # a tail-promoted waterfall stays connected; it just
+                    # loses the send-leg timing the head-sampled 1% keep.
+                    msg.trace = ctx
         self.out_seq += 1
         msg.seq = self.out_seq
         if not self.policy.lossy:
@@ -626,14 +641,15 @@ class Connection:
                 # and handler so reordered completions really interleave
                 await interleave.yield_point("msgr_dispatch")
             try:
-                if msg.trace is not None and tracer.enabled():
-                    # receiving-end messenger span: covers the handler,
-                    # nested under the sender's ms_send so the trace
-                    # stays connected across the socket; handlers' own
-                    # spans (PG, EC, store) nest under this context
-                    with tracer.span("ms_dispatch",
-                                     self.messenger.entity_name,
-                                     parent=msg.trace) as sp:
+                if msg.trace is not None and tracer.active():
+                    # receiving-end messenger scope: a real ms_dispatch
+                    # span for enabled/head-sampled traces, context-only
+                    # for unsampled ones; either way handlers' own
+                    # spans (PG, EC, store) nest under this context and
+                    # the trace stays connected across the socket
+                    with tracer.dispatch_scope("ms_dispatch",
+                                               self.messenger.entity_name,
+                                               parent=msg.trace) as sp:
                         if sp is not None:
                             sp.set_tag("type", type(msg).__name__)
                             sp.set_tag("bytes", len(msg.data))

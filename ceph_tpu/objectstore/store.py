@@ -178,7 +178,7 @@ def _observed_txn(fn):
     @functools.wraps(fn)
     def queue_transaction(self, txn):
         perf = self.commit_perf
-        if perf is None and not tracer.enabled():
+        if perf is None and not tracer.active():
             return fn(self, txn)
         t0 = time.perf_counter()
         try:

@@ -1096,6 +1096,15 @@ class OffloadService:
                     stack_us = round(stack_s * 1e6, 1) if staging \
                         is not None else 0.0
                     with tracer.span("offload_batch") as sp:
+                        if sp is not None:
+                            # span links (tracing v2): the coalesced
+                            # batch serves riders from many PGs and
+                            # processes — link every rider's trace so
+                            # `trace get <rider>` pulls this span in
+                            for j in jobs:
+                                if j.span is not None and \
+                                        j.span.trace_id != sp.trace_id:
+                                    sp.add_link(j.span.context())
                         out, on_device = await self._dispatch(
                             bucket, slot, stacked, len(jobs), sp,
                             token)

@@ -153,10 +153,12 @@ class OSD(Dispatcher):
         # flight_ring_capacity 2048` resizes the process-wide event
         # ring live; `config set flight_enabled false` silences it
         flight.register_config(self.config)
-        # the profiler/copy-ledger counter mirrors must exist before the
-        # first MgrClient report so their families export from round one
+        # the profiler/copy-ledger/tracer counter mirrors must exist
+        # before the first MgrClient report so their families export
+        # from round one
         loopprof.perf()
         copytrack.perf()
+        tracer.perf()
         # per-daemon perf counters, served by `perf dump` (the admin
         # socket reads the process-wide collection)
         coll = PerfCountersCollection.instance()
@@ -293,7 +295,7 @@ class OSD(Dispatcher):
             device_cb=self._mgr_device_metrics,
             client_cb=self._mgr_client_metrics,
             extra_loggers=("offload", "sanitizer", "loopprof",
-                           "copyflow", "msgr"))
+                           "copyflow", "msgr", "tracer"))
         # the per-loop offload service handle (set at start(): the
         # admin-socket thread cannot resolve the running loop itself)
         self._offload_svc = None

@@ -923,9 +923,13 @@ class PGInstance:
         do_osd_ops dispatch table (src/osd/PrimaryLogPG.cc:5989). Traced
         as the `pg_op` stage of the op's trace (nested under the
         daemon's osd_op span; the EC/store spans nest under this)."""
-        if not tracer.enabled():
+        if not tracer.active():
             return await self._do_op(op, data, conn)
-        with tracer.span("pg_op", f"osd.{self.host.whoami}") as sp:
+        # structural span (no stage claim of its own): elided on
+        # unsampled traces — osd_op spans the same interval and the
+        # EC/store children reparent under it via the live context
+        with tracer.span_sampled_only("pg_op",
+                                      f"osd.{self.host.whoami}") as sp:
             if sp is not None:      # hot-toggle race: may disable mid-call
                 sp.set_tag("pg", f"{self.pgid.pool}.{self.pgid.ps}")
                 sp.set_tag("op", op.get("op"))

@@ -102,7 +102,8 @@ class AioDispatcher:
                 # the task inherits the submitter's trace context, so an
                 # aio op traced from application code stays one trace;
                 # this span additionally shows throttle-queue wait
-                with tracer.span("aio_op", "client"):
+                # (elided on unsampled traces — rados_op covers it)
+                with tracer.span_sampled_only("aio_op", "client"):
                     await self._throttle.acquire()
                     acquired = True
                     comp._finish(await coro)

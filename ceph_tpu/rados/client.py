@@ -169,9 +169,10 @@ class RadosClient(Dispatcher):
         """Objecter::op_submit-lite: compute the target, send, resend on
         epoch change / wrong-primary / transport fault. `pgid` pins the
         target PG (PG-scoped ops like `list`). When tracing is on, this
-        opens the ROOT span of the op's trace; every messenger hop and
-        OSD-side stage nests under it."""
-        if not tracer.enabled():
+        opens the ROOT span of the op's trace (where the head-sampling
+        decision is drawn); every messenger hop and OSD-side stage
+        nests under it."""
+        if not tracer.active():
             return await self._submit_inner(pool_name, oid, ops, data,
                                             timeout, pgid, attempt_timeout)
         with tracer.span("rados_op", "client") as sp:
@@ -180,6 +181,7 @@ class RadosClient(Dispatcher):
                 sp.set_tag("oid", oid)
                 sp.set_tag("ops", "+".join(o.get("op", "?") for o in ops))
                 sp.set_tag("bytes", len(data))
+                sp.set_tag("client", self.name)
             return await self._submit_inner(pool_name, oid, ops, data,
                                             timeout, pgid, attempt_timeout)
 

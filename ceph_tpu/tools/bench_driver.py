@@ -1890,8 +1890,93 @@ def stage_attribution() -> dict:
                     f"loop_busy {att['loop_busy_fraction']} "
                     f"shards={att['per_shard']} "
                     f"skew={att['shard_busy_skew']}")
+                # tracing-overhead A/B (tracing v2): off vs the
+                # always-on production config (sample_rate=0.01 + tail
+                # retention) vs full tracing, same cluster, same write
+                # phase. Each mode window is SANDWICHED between off
+                # windows and scored against their mean: the shared
+                # cluster AGES monotonically across windows (pg log
+                # windows fill, object count grows — the same handicap
+                # the pipeline sweep dodges with fresh clusters), so any
+                # schedule that compares windows far apart in time —
+                # sequential blocks, even rotated round-robins — books
+                # aging as tracer cost. Adjacent offs age ~equally and
+                # the sandwich cancels linear drift in either direction;
+                # a discarded warmup window absorbs first-window JIT /
+                # allocator effects, and best-of-reps on the ratio
+                # drops one-off stall windows (compaction, GC) that
+                # would otherwise land on whichever mode drew them.
+                # profile_dispatch is OFF for both modes — sampling
+                # must never imply the serialized attribution mode, and
+                # this measures that claim. The guarded key is the
+                # production config.
+                tracer.set_profile_dispatch(False)
+                AB_SECONDS, AB_REPS = 1.5, 3
+
+                def _arm_off() -> None:
+                    tracer.disable()
+                    tracer.set_sampling(rate=0.0, tail_slow_ms=0.0)
+
+                def _arm_sampled() -> None:
+                    tracer.disable()
+                    tracer.set_sampling(rate=0.01, tail_slow_ms=250.0)
+
+                def _arm_full() -> None:
+                    tracer.set_sampling(rate=0.0, tail_slow_ms=0.0)
+                    tracer.enable(max_spans=65536)
+
+                async def _ab_window() -> float:
+                    tracer.reset()
+                    r = await _phase(io, "write", CONC, AB_SECONDS,
+                                     OBJ, {})
+                    await svc.drain()
+                    return r["mb_per_s"]
+
+                ab_modes = [("sampled_tail", _arm_sampled),
+                            ("full", _arm_full)]
+                ab_ratio = {name: 0.0 for name, _ in ab_modes}
+                ab_rate = {name: 0.0 for name, _ in ab_modes}
+                ab_off = 0.0
+                _arm_off()
+                await _ab_window()          # warmup, discarded
+                for _rep in range(AB_REPS):
+                    # chain: off, sampled, off, full, off — each mode
+                    # window scored vs the mean of its two neighbours
+                    _arm_off()
+                    off_prev = await _ab_window()
+                    for name, arm in ab_modes:
+                        arm()
+                        rate = await _ab_window()
+                        _arm_off()
+                        off_next = await _ab_window()
+                        base = (off_prev + off_next) / 2.0
+                        ab_off = max(ab_off, base)
+                        ab_rate[name] = max(ab_rate[name], rate)
+                        if base > 0:
+                            ab_ratio[name] = max(ab_ratio[name],
+                                                 rate / base)
+                        off_prev = off_next
+                tracer.disable()
+                tracer.reset()
+
+                def _overhead(ratio: float) -> float:
+                    return round(max(0.0, (1.0 - ratio) * 100.0), 2)
+                results["tracing_ab_mb_s"] = {
+                    "off": round(ab_off, 2),
+                    "sampled_tail": round(ab_rate["sampled_tail"], 2),
+                    "full": round(ab_rate["full"], 2)}
+                results["tracing_overhead_pct"] = \
+                    _overhead(ab_ratio["sampled_tail"])
+                results["tracing_overhead_full_pct"] = \
+                    _overhead(ab_ratio["full"])
+                log(f"attribution: tracing A/B off={ab_off:.1f} "
+                    f"sampled+tail={ab_rate['sampled_tail']:.1f} "
+                    f"full={ab_rate['full']:.1f} MB/s -> overhead "
+                    f"{results['tracing_overhead_pct']}% "
+                    f"(full {results['tracing_overhead_full_pct']}%)")
             finally:
                 tracer.disable()
+                tracer.set_sampling(rate=0.0, tail_slow_ms=0.0)
                 tracer.set_profile_dispatch(False)
                 try:
                     loopprof.uninstall()
@@ -2059,7 +2144,8 @@ TREND_KEYS_COST = ("copy_amplification", "loop_busy_fraction",
                    "pg_pipeline_stall_fraction",
                    "interleave_sanitizer_overhead_pct",
                    "flight_history_overhead_pct",
-                   "failure_storm_p99_area_ms_s")
+                   "failure_storm_p99_area_ms_s",
+                   "tracing_overhead_pct")
 TREND_THRESHOLD_PCT = 10.0
 
 

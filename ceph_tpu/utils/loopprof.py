@@ -195,6 +195,10 @@ def install(loop: asyncio.AbstractEventLoop | None = None,
         if start_thread:
             _thread = threading.Thread(target=_sample_loop, daemon=True,
                                        name="loopprof-sampler")
+        # span CMs mirror their name per-task only while a sampler can
+        # read it — the mirror costs weak-dict ops on the tracing hot
+        # path, so the tracer keeps it off otherwise
+        tracer.set_task_naming(True)
     if start_thread:
         _thread.start()
     perf()
@@ -208,6 +212,8 @@ def uninstall(loop: asyncio.AbstractEventLoop | None = None) -> None:
         loop = asyncio.get_running_loop()
     with _lock:
         st = _loops.pop(loop, None)
+        if not _loops:
+            tracer.set_task_naming(False)
     if st and st["owns_factory"] and not loop.is_closed() \
             and loop.get_task_factory() is sanitizer.task_factory \
             and not sanitizer.armed(loop):

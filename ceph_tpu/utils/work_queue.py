@@ -495,6 +495,16 @@ class TrackedOp:
                 out["tenant"] = self.tenant
         if self.trace is not None:
             out["trace_id"] = format(self.trace["t"], "016x")
+            # per-stage durations from the op's span SKELETON (tracing
+            # v2 tail reservoir: name -> max µs) — slow-op triage works
+            # even on daemons whose traces were never sampled/promoted
+            try:
+                from ceph_tpu.utils import tracer
+                stages = tracer.op_stages(self.trace["t"])
+            except Exception:
+                stages = None
+            if stages:
+                out["stages_us"] = stages
         return out
 
 

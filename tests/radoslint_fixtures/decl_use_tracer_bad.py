@@ -1,0 +1,11 @@
+"""Known-positive decl-use: tracing-v2 surface declared the way a
+half-finished port would — a sampling knob with no observer and no
+config.get, and a tail counter nobody increments — one dead Option,
+one ghost counter the lint must flag."""
+
+
+def declare(config, perf, Option):
+    config.declare(Option("tracerdead_sample_rate", "float", 0.0,
+                          "sampling knob nobody applies"))
+    perf.add("tracedead_tail_promoted",
+             description="counter nobody ever bumps")

@@ -1,0 +1,46 @@
+"""Known-negative decl-use: the tracing-v2 pattern from
+utils/tracer.py — the sampling/tail option family declared and applied
+through an observer tuple plus an initial config.get sweep, and the
+tail-retention counters declared with literal names and bumped with
+literal names on the promote/evict/ship paths — all live uses the
+lint must honor."""
+
+_STATE = {"sample_rate": 0.0, "tail_slow_ms": 0.0}
+
+
+def TRACER_OPTIONS(Option):
+    return [Option("tracer_sample_rate", "float",
+                   _STATE["sample_rate"],
+                   "head-sampling probability, applied below"),
+            Option("tracer_tail_slow_ms", "float",
+                   _STATE["tail_slow_ms"],
+                   "tail promotion threshold, applied below")]
+
+
+def register_config(config, Option):
+    names = []
+    for opt in TRACER_OPTIONS(Option):
+        names.append(opt.name)
+        config.declare(opt)
+
+    def _on_change(name, value):
+        _STATE[name[len("tracer_"):]] = value
+
+    config.add_observer(tuple(names), _on_change)
+    _STATE["sample_rate"] = config.get("tracer_sample_rate")
+    _STATE["tail_slow_ms"] = config.get("tracer_tail_slow_ms")
+
+
+def declare_counters(perf):
+    perf.add("trace_tail_promoted",
+             description="slow/errored traces promoted by the tail")
+    perf.add("trace_tail_evicted",
+             description="skeletons evicted before completing")
+
+
+def on_promote(perf):
+    perf.inc("trace_tail_promoted")
+
+
+def on_evict(perf):
+    perf.inc("trace_tail_evicted")

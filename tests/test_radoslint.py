@@ -52,6 +52,8 @@ def lint(path, rules):
      "decl_use_pipeline_good.py"),
     ("decl-use", "decl_use_flight_bad.py", 2,
      "decl_use_flight_good.py"),
+    ("decl-use", "decl_use_tracer_bad.py", 2,
+     "decl_use_tracer_good.py"),
     ("report-export-consistency", "report_export_bad.py", 1,
      "report_export_good.py"),
     ("view-escape", "view_escape_pos.py", 5, "view_escape_neg.py"),
@@ -408,7 +410,7 @@ def test_bench_trend_guard_prefers_newest_round():
     from ceph_tpu.tools.bench_driver import previous_bench
     prev = previous_bench(REPO)
     assert prev is not None
-    assert prev[0] == "BENCH_r05.json"
+    assert prev[0] == "BENCH_r06.json"
 
 
 # -- the tier-1 gate: zero non-baselined findings over ceph_tpu/ -------------

@@ -23,10 +23,12 @@ def clean_tracer():
     """Every test starts and ends with tracing off and the collector
     empty (the collector is process-wide)."""
     tracer.disable()
-    tracer.collector().reset()
+    tracer.set_sampling(rate=0.0, tail_slow_ms=0.0)
+    tracer.reset()
     yield
     tracer.disable()
-    tracer.collector().reset()
+    tracer.set_sampling(rate=0.0, tail_slow_ms=0.0)
+    tracer.reset()
 
 
 def _span_index(trace: dict) -> dict[str, dict]:
