@@ -63,6 +63,8 @@ FAILURE_STORM_TIMEOUT = 500  # kill/revive resilience + repair-ratio stage
 #                              (280) + cross-process flight-recorder
 #                              drill (170) + headroom
 SWARM_TIMEOUT = 320  # 200-client multi-tenant fairness + SLO pipeline stage
+QOS_STORM_TIMEOUT = 560  # 1000-client sharded storm, scheduler A/B +
+#                          recovery-under-storm + shed phase (520 body)
 INTERLEAVE_TIMEOUT = 440  # seed-swept schedule explorer + sanitizer AND
 #                           flight-recorder overhead (3 modes x 2 reps)
 METRIC = "ec_encode_k8m3_1MiB_chunk"
@@ -219,6 +221,18 @@ def main() -> int:
     swarm = run_stage("swarm", _hermetic_env(), _budget(SWARM_TIMEOUT))
     stages["swarm"] = swarm
 
+    # Stage 6b: QoS storm — the dmclock scheduler graded A/B under a
+    # 1000-client sharded swarm with three adversarial tenants and a
+    # paced victim band: fairness ratio + victim p99 + goodput with
+    # the arbiter ON vs the legacy WRR path, recovery progressing
+    # through its reservation during the storm, and the overload/shed
+    # admission-control phase (MOSDOpThrottle + flight crumbs +
+    # per-tenant ceph_qos_* counters). Hermetic: it measures
+    # arbitration, not codec speed.
+    qos = run_stage("qos_storm", _hermetic_env(),
+                    _budget(QOS_STORM_TIMEOUT))
+    stages["qos_storm"] = qos
+
     # Stage 7: interlock qa sweep — seeded schedule exploration over a
     # pipelined EC cluster, explorer-only vs explorer+sanitizer
     # (generation guards, lockset recorder): seeds run, distinct
@@ -242,6 +256,8 @@ def main() -> int:
     detail.update({k: v for k, v in storm.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})
     detail.update({k: v for k, v in swarm.items()
+                   if k not in ("status", "elapsed_s", "stderr_tail")})
+    detail.update({k: v for k, v in qos.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})
     detail.update({k: v for k, v in ilv.items()
                    if k not in ("status", "elapsed_s", "stderr_tail")})

@@ -55,7 +55,8 @@ class DaemonState:
 
     __slots__ = ("name", "service", "schema", "counters", "status",
                  "health_metrics", "progress", "device_metrics",
-                 "client_metrics", "last_report_mono", "reports")
+                 "client_metrics", "qos_metrics", "last_report_mono",
+                 "reports")
 
     def __init__(self, name: str, service: str):
         self.name = name
@@ -67,6 +68,7 @@ class DaemonState:
         self.progress: list = []
         self.device_metrics: dict = {}
         self.client_metrics: dict = {}
+        self.qos_metrics: dict = {}
         self.last_report_mono = time.monotonic()
         self.reports = 0
 
@@ -141,6 +143,8 @@ class DaemonStateIndex:
         st.device_metrics = dm if isinstance(dm, dict) else {}
         cm = payload.get("client_metrics")
         st.client_metrics = cm if isinstance(cm, dict) else {}
+        qm = payload.get("qos_metrics")
+        st.qos_metrics = qm if isinstance(qm, dict) else {}
         st.last_report_mono = time.monotonic()
         st.reports += 1
         # time-resolved leg: sample the MERGED counter state at the
@@ -232,6 +236,35 @@ class DaemonStateIndex:
         return [(name, st.client_metrics)
                 for name, st in sorted(self.daemons.items())
                 if st.client_metrics]
+
+    def qos_sources(self) -> list[tuple[str, dict]]:
+        """(daemon, {tenant: qos ledger}) pairs — one per reporting
+        OSD running the dmclock scheduler."""
+        return [(name, st.qos_metrics)
+                for name, st in sorted(self.daemons.items())
+                if st.qos_metrics]
+
+    #: numeric per-tenant QoS fields summed in the cross-OSD merge
+    _QOS_SUM_FIELDS = ("shed", "deferred", "dequeue_reservation",
+                       "dequeue_weight", "queued", "cost")
+
+    def qos_aggregate(self) -> dict[str, dict]:
+        """Cross-OSD merge per tenant: a tenant's ops spread over every
+        primary it touches, so its cluster-wide shed/deferred/dequeue
+        ledger is the SUM of each OSD's."""
+        agg: dict[str, dict] = {}
+        for _daemon, qm in self.qos_sources():
+            for tenant, d in qm.items():
+                if not isinstance(d, dict):
+                    continue
+                e = agg.setdefault(str(tenant),
+                                   {f: 0 for f in self._QOS_SUM_FIELDS})
+                for f in self._QOS_SUM_FIELDS:
+                    v = d.get(f)
+                    if isinstance(v, (int, float)) and \
+                            not isinstance(v, bool):
+                        e[f] += v
+        return agg
 
     #: numeric per-client fields summed in the cross-OSD merge
     _CLIENT_SUM_FIELDS = ("ops", "read_ops", "write_ops", "read_bytes",

@@ -196,6 +196,21 @@ def render_metrics(health: dict | None = None, index=None,
                 fam = families.setdefault(
                     metric, {"type": ftype, "rows": []})
                 fam["rows"].append(f"{metric}{{{clabel}}} {v}")
+        # per-tenant QoS families (the dmclock scheduler's ledger):
+        # shed/deferred/dequeue-phase splits merged ACROSS OSDs,
+        # rendered with a `tenant` label. Cardinality is bounded by
+        # the scheduler's own entity-table cap.
+        for tenant, e in sorted(index.qos_aggregate().items()):
+            tlabel = f'tenant="{_label_escape(str(tenant))}"'
+            for field, v in sorted(e.items()):
+                if not isinstance(v, (int, float)) or \
+                        isinstance(v, bool):
+                    continue
+                metric = f"ceph_qos_{_sanitize(field)}"
+                fam = families.setdefault(
+                    metric, {"type": "gauge" if field == "queued"
+                             else "counter", "rows": []})
+                fam["rows"].append(f"{metric}{{{tlabel}}} {v}")
         fam = families.setdefault("ceph_daemon_report_age_seconds",
                                   {"type": "gauge", "rows": []})
         for daemon, age in index.report_ages().items():

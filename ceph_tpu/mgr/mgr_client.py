@@ -47,6 +47,7 @@ class MgrClient(Dispatcher):
                  progress_cb: Callable[[], list] | None = None,
                  device_cb: Callable[[], dict] | None = None,
                  client_cb: Callable[[], dict] | None = None,
+                 qos_cb: Callable[[], dict] | None = None,
                  perf_name: str | None = None,
                  extra_loggers: tuple[str, ...] = ()):
         self.messenger = messenger
@@ -65,6 +66,10 @@ class MgrClient(Dispatcher):
         # {client: {counter/buckets}}, merged ACROSS daemons in the mgr
         # and exported as ceph_client_* with a `ceph_client` label
         self.client_cb = client_cb
+        # per-tenant QoS ledger (the dmclock scheduler's shed/deferred/
+        # dequeue-phase splits): {tenant: {counter: value}}, exported
+        # as ceph_qos_* with a `tenant` label
+        self.qos_cb = qos_cb
         self.perf_name = perf_name or daemon_name
         # process-shared perf loggers this daemon also reports (e.g. the
         # EC offload service's "offload" counters), merged into the
@@ -189,6 +194,7 @@ class MgrClient(Dispatcher):
         payload["progress"] = self._safe(self.progress_cb, [])
         payload["device_metrics"] = self._safe(self.device_cb, {})
         payload["client_metrics"] = self._safe(self.client_cb, {})
+        payload["qos_metrics"] = self._safe(self.qos_cb, {})
         # flight-recorder leg: the ring tail since the last report,
         # plus the anchor pair the mgr's timeline merge needs. Shipped
         # every report (an empty tail still refreshes the anchors);

@@ -166,6 +166,12 @@ MOSDOp = _simple(0x40, "MOSDOp",  # {"tid", "pg": "pool.ps", "oid",
                                           #          "off", "len", ...}],
                                           #  "epoch": client map epoch}
 MOSDOpReply = _simple(0x41, "MOSDOpReply")  # {"tid", "rc", "out": [...]}
+# QoS admission control refusal (the dmclock shed policy): an op the
+# OSD would have queued past a tenant's depth cap bounces with an
+# EAGAIN-style rc and a pacing hint — the client backs off WITHOUT a
+# map refresh (the map is fine; the tenant is over its share) and
+# resends the same tid. {"tid", "rc": -11, "retry_after_ms", "epoch"}
+MOSDOpThrottle = _simple(0x42, "MOSDOpThrottle")
 
 # -- replication (MOSDRepOp, src/messages/MOSDRepOp.h) -----------------------
 MOSDRepOp = _simple(0x50, "MOSDRepOp",       # primary -> replica txn

@@ -22,7 +22,8 @@ import time
 
 from ceph_tpu.crush.osdmap import PG, Incremental, OSDMap
 from ceph_tpu.mgr.mgr_client import MgrClient
-from ceph_tpu.msg.messages import (Message, MOSDOp, MOSDOpReply, MOSDPGInfo,
+from ceph_tpu.msg.messages import (Message, MOSDOp, MOSDOpReply,
+                                   MOSDOpThrottle, MOSDPGInfo,
                                    MOSDPGLog, MOSDPGPush, MOSDPGPushReply,
                                    MOSDPGQuery, MOSDRepOp, MOSDRepOpReply,
                                    MOSDRepScrub, MOSDRepScrubMap,
@@ -119,6 +120,51 @@ class OSD(Dispatcher):
                    "bound of the per-client accounting table; the "
                    "least-recently-active overflow folds into _other "
                    "(hot: resizes the live table)", minimum=2),
+            # dmclock QoS arbiter (osd/scheduler/): every knob is hot
+            # — the observer pushes changes into the live scheduler,
+            # so an operator can impose a limit or flip the overload
+            # policy mid-storm
+            Option("osd_mclock_enabled", "bool", False,
+                   "arbitrate op dequeue by per-tenant reservation/"
+                   "limit/weight tag clocks instead of the legacy "
+                   "class WRR (hot: queued work migrates)"),
+            Option("osd_mclock_cost_per_io_bytes", "size", 65536,
+                   "payload bytes worth one extra IO of scheduling "
+                   "cost (byte-normalization of the tag clocks)",
+                   minimum=1),
+            Option("osd_mclock_client_reservation", "float", 0.0,
+                   "guaranteed cost-units/sec per client tenant "
+                   "(0 = no floor)", minimum=0.0),
+            Option("osd_mclock_client_limit", "float", 0.0,
+                   "cost-units/sec cap per client tenant (0 = "
+                   "uncapped)", minimum=0.0),
+            Option("osd_mclock_client_weight", "float", 1.0,
+                   "proportional share of excess capacity per client "
+                   "tenant", minimum=0.0),
+            Option("osd_mclock_recovery_reservation", "float", 4.0,
+                   "guaranteed cost-units/sec for the recovery class "
+                   "pseudo-entity (nonzero keeps recovery progressing "
+                   "under client floods)", minimum=0.0),
+            Option("osd_mclock_recovery_limit", "float", 0.0,
+                   "cost-units/sec cap for recovery (0 = uncapped)",
+                   minimum=0.0),
+            Option("osd_mclock_recovery_weight", "float", 0.5,
+                   "recovery's proportional share of excess capacity",
+                   minimum=0.0),
+            Option("osd_mclock_overload_policy", "str", "backpressure",
+                   "past-saturation admission control: backpressure "
+                   "defers dequeue until limit tags mature; shed "
+                   "refuses enqueue with an EAGAIN-style throttle "
+                   "reply once a tenant's backlog passes "
+                   "osd_mclock_shed_queue_depth",
+                   enum=("backpressure", "shed")),
+            Option("osd_mclock_shed_queue_depth", "int", 256,
+                   "per-tenant queued-op depth that triggers shedding "
+                   "(shed policy only)", minimum=1),
+            Option("osd_mclock_tenant_profiles", "str", "",
+                   "JSON {tenant: {reservation, limit, weight}} "
+                   "per-tenant overrides of the osd_mclock_client_* "
+                   "defaults"),
         ])
         # op tracing rides the same config (hot-togglable: `config set
         # tracer_enabled true` over the admin socket starts collecting)
@@ -193,6 +239,21 @@ class OSD(Dispatcher):
                       description="shard-worker waits with queued work "
                                   "blocked behind a full per-PG "
                                   "pipeline window")
+        # dmclock QoS ledger (per-tenant splits ride the MgrReport
+        # qos_metrics leg; these are the daemon-wide aggregates)
+        self.perf.add("qos_shed",
+                      description="client ops refused by shed "
+                                  "admission control (throttle reply)")
+        self.perf.add("qos_deferred_waits",
+                      description="shard-worker sleeps with every "
+                                  "queued tenant limit-blocked "
+                                  "(backpressure)")
+        self.perf.add("qos_dequeue_reservation",
+                      description="ops dequeued by the reservation "
+                                  "phase (tenant behind its floor)")
+        self.perf.add("qos_dequeue_weight",
+                      description="ops dequeued by the weight phase "
+                                  "(proportional share)")
         self.perf.add("op_total_us", type=TYPE_HISTOGRAM,
                       description="client op total latency (µs)")
         self.perf.add("op_queue_wait_us", type=TYPE_HISTOGRAM,
@@ -229,6 +290,22 @@ class OSD(Dispatcher):
             perf=self.perf)
         self.config.add_observer(("osd_pg_pipeline_depth",),
                                  self._on_pipeline_depth)
+        # dmclock arbiter wiring: seed the scheduler from the knobs,
+        # then keep it live via the observer (every osd_mclock_* knob
+        # is hot, including the enable toggle — queued work migrates)
+        self._apply_qos_knobs()
+        self.op_queue.set_mclock_enabled(
+            self.config.get("osd_mclock_enabled"))
+        self.config.add_observer(
+            ("osd_mclock_enabled", "osd_mclock_cost_per_io_bytes",
+             "osd_mclock_client_reservation",
+             "osd_mclock_client_limit", "osd_mclock_client_weight",
+             "osd_mclock_recovery_reservation",
+             "osd_mclock_recovery_limit", "osd_mclock_recovery_weight",
+             "osd_mclock_overload_policy",
+             "osd_mclock_shed_queue_depth",
+             "osd_mclock_tenant_profiles"),
+            self._on_qos_knobs)
         self.finisher = Finisher(f"osd.{whoami}.finisher",
                                  hb_map=self.hb_map)
         self.asok: AdminSocket | None = None
@@ -248,10 +325,16 @@ class OSD(Dispatcher):
                 "recently completed slow ops")
             self.asok.register_command(
                 "dump_clients",
-                lambda req: self.optracker.clients.dump_clients(
-                    req.get("limit")),
+                lambda req: self._dump_clients(req.get("limit")),
                 "per-client accounting: ops/bytes/in-flight, rolling "
-                "p50/p99 per class, SLO good-vs-violating counters")
+                "p50/p99 per class, SLO good-vs-violating counters, "
+                "live QoS tag clocks")
+            self.asok.register_command(
+                "qos status",
+                lambda req: self.op_queue.qos_status(),
+                "dmclock scheduler: per-tenant tag clocks, "
+                "reservation/limit/weight in force, shed/deferred "
+                "ledger")
             self.asok.register_command(
                 "scrub",
                 lambda req: self._trigger_scrub(req.get("deep", False)),
@@ -294,6 +377,7 @@ class OSD(Dispatcher):
             progress_cb=self._mgr_progress,
             device_cb=self._mgr_device_metrics,
             client_cb=self._mgr_client_metrics,
+            qos_cb=self._mgr_qos_metrics,
             extra_loggers=("offload", "sanitizer", "loopprof",
                            "copyflow", "msgr", "tracer"))
         # the per-loop offload service handle (set at start(): the
@@ -458,6 +542,22 @@ class OSD(Dispatcher):
         `ceph_client_*` families with a `ceph_client` label."""
         return self.optracker.clients.mgr_metrics()
 
+    def _mgr_qos_metrics(self) -> dict:
+        """Per-tenant QoS ledger (shed/deferred/dequeue-phase splits)
+        for the report path: the exporter renders them as `ceph_qos_*`
+        families with a `tenant` label."""
+        return self.op_queue.sched.tenant_metrics()
+
+    def _dump_clients(self, limit=None) -> dict:
+        """dump_clients + the live QoS tag columns of each client's
+        scheduling entity (its tenant, or itself when untenanted)."""
+        dump = self.optracker.clients.dump_clients(limit)
+        sched = self.op_queue.sched
+        for row in dump.get("clients", []):
+            row.update(sched.tag_columns(
+                row.get("tenant") or row.get("client")))
+        return dump
+
     def _on_client_knobs(self, name: str, value) -> None:
         """slo_read_ms / slo_write_ms / osd_max_client_entries observer:
         pushed straight into the live ClientTable (its own lock makes
@@ -500,6 +600,43 @@ class OSD(Dispatcher):
         """osd_pg_pipeline_depth observer: hot-resize the live per-PG
         admission window."""
         self._run_on_loop(self.op_queue.set_pipeline_depth, int(value))
+
+    def _apply_qos_knobs(self) -> None:
+        """Push every osd_mclock_* value into the live scheduler."""
+        cfg = self.config
+        profiles: dict = {}
+        raw = cfg.get("osd_mclock_tenant_profiles")
+        if raw:
+            try:
+                parsed = json.loads(raw)
+                if isinstance(parsed, dict):
+                    profiles = {str(k): v for k, v in parsed.items()
+                                if isinstance(v, dict)}
+            except (ValueError, TypeError):
+                dout("osd", 1, f"osd.{self.whoami}: bad "
+                               f"osd_mclock_tenant_profiles JSON ignored")
+        self.op_queue.configure_qos(
+            cost_per_io_bytes=cfg.get("osd_mclock_cost_per_io_bytes"),
+            client_reservation=cfg.get("osd_mclock_client_reservation"),
+            client_limit=cfg.get("osd_mclock_client_limit"),
+            client_weight=cfg.get("osd_mclock_client_weight"),
+            tenant_profiles=profiles,
+            overload_policy=cfg.get("osd_mclock_overload_policy"),
+            shed_queue_depth=cfg.get("osd_mclock_shed_queue_depth"),
+            class_params={"recovery": {
+                "reservation": cfg.get("osd_mclock_recovery_reservation"),
+                "limit": cfg.get("osd_mclock_recovery_limit"),
+                "weight": cfg.get("osd_mclock_recovery_weight")}})
+
+    def _on_qos_knobs(self, name: str, value) -> None:
+        """osd_mclock_* observer: the enable toggle migrates queued
+        work (loop-bound); parameter knobs re-resolve every live
+        entity's tags."""
+        if name == "osd_mclock_enabled":
+            self._run_on_loop(self.op_queue.set_mclock_enabled,
+                              bool(value))
+        else:
+            self._run_on_loop(self._apply_qos_knobs)
 
     def _on_recovery_slots(self, name: str, value) -> None:
         """osd_max_recovery_in_flight observer: resize the live slot
@@ -1155,8 +1292,27 @@ class OSD(Dispatcher):
             self.perf.hist_add("op_queue_wait_us", wait_us)
             await self._execute_op(conn, msg, trk,
                                    queue_wait_us=round(wait_us, 1))
-        self.op_queue.enqueue((pgid.pool, pgid.ps), work,
-                              obj=self._op_object(msg))
+        p = msg.payload
+        nbytes = len(msg.data) or sum(int(o.get("len") or 0)
+                                      for o in p.get("ops", []))
+        admitted = self.op_queue.enqueue(
+            (pgid.pool, pgid.ps), work, obj=self._op_object(msg),
+            entity=trk.tenant or trk.client, nbytes=nbytes)
+        if not admitted:
+            # shed admission control: the tenant's backlog is past the
+            # depth cap — refuse with a pacing hint instead of letting
+            # queue depth and p99 run away. The client resends the
+            # same tid after the backoff; no map refresh (the map is
+            # fine, the tenant is over its share).
+            trk.mark_event("qos_shed")
+            trk.finish()
+            try:
+                conn.send_message(MOSDOpThrottle(
+                    {"tid": p.get("tid", 0), "rc": -11,
+                     "retry_after_ms": 50,
+                     "epoch": self.osdmap.epoch}))
+            except Exception:
+                pass
 
     def requeue_waiting(self, pg: PGInstance) -> None:
         """PG activation (or loss of primacy) drains its parked ops in

@@ -572,9 +572,14 @@ class PGInstance:
                 # pipelined window alongside client ops to OTHER
                 # objects, but serializes FIFO against any client op
                 # touching the object being rebuilt
+                # nbytes: a push moves whole shard chunks, so bill the
+                # recovery entity one full per-IO byte budget (~2 cost
+                # units) rather than metering the exact object size —
+                # the tag clocks need relative pressure, not a ledger
                 self.host.op_queue.enqueue(
                     (self.pgid.pool, self.pgid.ps), work,
-                    klass="recovery", obj=oid)
+                    klass="recovery", obj=oid,
+                    nbytes=self.host.op_queue.sched.cost_per_io_bytes)
                 await done
                 if oid in self._pending_recovery:
                     # push failed and was re-queued: back off instead of

@@ -21,7 +21,8 @@ import time
 from ceph_tpu.crush.crush import CRUSH_NONE
 from ceph_tpu.crush.osdmap import Incremental, OSDMap
 from ceph_tpu.msg.messages import (Message, MOSDOp, MOSDOpReply,
-                                   MWatchNotify, MWatchNotifyAck)
+                                   MOSDOpThrottle, MWatchNotify,
+                                   MWatchNotifyAck)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
 from ceph_tpu.mon.mon_client import MonClient
 from ceph_tpu.utils import tracer
@@ -81,6 +82,9 @@ class RadosClient(Dispatcher):
         self._map_changed = asyncio.Event()
         self._tid = 0
         self._reqseq = 0
+        # ops bounced by QoS shed admission control (MOSDOpThrottle
+        # replies absorbed by the backoff-and-resend path)
+        self.throttled_ops = 0
         self._waiters: dict[int, asyncio.Future] = {}
         self._osd_conns: dict[int, Connection] = {}
         # linger watches (Objecter linger ops): cookie -> registration;
@@ -244,6 +248,21 @@ class RadosClient(Dispatcher):
                 self._waiters.pop(tid, None)
             p, outdata = reply
             rc = p.get("rc", 0)
+            if "retry_after_ms" in p:
+                # QoS shed (MOSDOpThrottle): the map is fine — the
+                # tenant is over its share. Honor the OSD's pacing
+                # hint (scaled up on consecutive bounces, bounded by
+                # the op deadline) and resend the same tid; no map
+                # refresh, no connection teardown.
+                self.throttled_ops += 1
+                last = "throttled (qos shed)"
+                delay = (float(p.get("retry_after_ms") or 50) / 1e3
+                         * min(attempt, 8))
+                delay = min(delay, max(0.0,
+                                       deadline - time.monotonic()))
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                continue
             if rc == -11:            # wrong primary / stale map: recompute
                 last = p.get("error", "wrong target")
                 await self._refresh_map(deadline)
@@ -353,7 +372,10 @@ class RadosClient(Dispatcher):
     # -- dispatch ------------------------------------------------------------
 
     async def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
-        if isinstance(msg, MOSDOpReply):
+        if isinstance(msg, (MOSDOpReply, MOSDOpThrottle)):
+            # a throttle is delivered through the same waiter: the
+            # submit loop recognizes the retry_after_ms marker and
+            # backs off WITHOUT a map refresh (QoS shed, not topology)
             fut = self._waiters.get(msg.payload.get("tid", 0))
             if fut is not None and not fut.done():
                 fut.set_result((msg.payload, msg.data))

@@ -41,6 +41,7 @@ import threading
 import time
 from typing import Awaitable, Callable
 
+from ceph_tpu.osd.scheduler import MClockScheduler, default_profile
 from ceph_tpu.utils import flight
 from ceph_tpu.utils.async_util import being_cancelled
 from ceph_tpu.utils.dout import dout
@@ -678,23 +679,60 @@ class ShardedOpQueue:
     Hot-resizable via set_pipeline_depth (the osd_pg_pipeline_depth
     observer); completions refill the window (completion-driven
     admission, no polling).
+
+    dmclock mode (`osd_mclock_enabled`, set_mclock_enabled): the WRR
+    class split is replaced by per-ENTITY tag-clock arbitration
+    (osd/scheduler/dmclock.py) — an entity is a client tenant or a
+    background class's pseudo-entity; each shard keeps one FIFO per
+    entity and the scheduler orders entities by reservation/limit/
+    weight tags, byte-cost normalized. The window/ordering guarantees
+    above carry over per entity queue: same-object FIFO and obj=None
+    barriers hold WITHIN an entity (Ceph's ordering contract is
+    per-client; cross-tenant same-object execution still serializes on
+    the windows, only admission order is QoS-arbitrated). Overload:
+    limit-blocked shards sleep until the earliest l_tag matures
+    (backpressure) or enqueue refuses past a depth cap (shed — the
+    caller replies EAGAIN-style). Toggling is hot: queued items
+    migrate between the class and entity queues preserving arrival
+    order, and with the scheduler OFF this code path is bit-identical
+    to the legacy WRR queue.
     """
 
-    WEIGHTS = {"client": 4, "recovery": 1, "scrub": 1}
+    #: legacy-path class weights, derived from the declared profile
+    #: (satellite fix: classes are registered in
+    #: osd/scheduler/profile.py, not hardcoded — the phantom `scrub`
+    #: entry with no producer is gone)
+    WEIGHTS = default_profile().wrr_weights()
 
     def __init__(self, name: str = "osd_op_tp", num_shards: int = 5,
                  hb_map: HeartbeatMap | None = None,
                  hb_grace: float = 30.0, pipeline_depth: int = 1,
-                 perf: "PerfCounters | None" = None):
+                 perf: "PerfCounters | None" = None,
+                 profile=None, clock=time.monotonic):
         self.name = name
         self.num_shards = num_shards
-        # each queued item is (key, obj, work)
+        self.profile = profile if profile is not None \
+            else default_profile()
+        self._weights = self.profile.wrr_weights()
+        # each queued item is (key, obj, work, entity, cost, seq);
+        # entity/cost ride along even on the legacy path so a hot
+        # toggle can migrate queued work without losing attribution
         self._queues: list[dict[str, collections.deque]] = [
-            {k: collections.deque() for k in self.WEIGHTS}
+            {k: collections.deque() for k in self._weights}
             for _ in range(num_shards)]
         self._wake = [asyncio.Event() for _ in range(num_shards)]
         self._credits: list[dict[str, int]] = [
-            dict(self.WEIGHTS) for _ in range(num_shards)]
+            dict(self._weights) for _ in range(num_shards)]
+        # dmclock mode: per-shard entity -> deque of
+        # (key, obj, work, klass, cost, seq)
+        self.sched = MClockScheduler(self.profile, clock=clock)
+        self.mclock_enabled = False
+        self._ent_queues: list[dict[str, collections.deque]] = [
+            {} for _ in range(num_shards)]
+        self._defer: list[float | None] = [None] * num_shards
+        self._seq = 0
+        self._last_defer_flight = 0.0
+        self.deferred_waits = 0
         self._inflight: list[dict] = [{} for _ in range(num_shards)]
         self._exec_tasks: list[set] = [set() for _ in range(num_shards)]
         self._stalled = [False] * num_shards
@@ -766,6 +804,72 @@ class ShardedOpQueue:
         for ev in self._wake:
             ev.set()
 
+    def set_mclock_enabled(self, enabled: bool) -> None:
+        """Hot-toggle the dmclock arbiter (osd_mclock_enabled
+        observer). Queued work MIGRATES between the legacy class
+        queues and the per-entity queues preserving arrival order
+        (every item carries its enqueue seq), so a toggle mid-storm
+        loses nothing and reorders nothing within an entity."""
+        enabled = bool(enabled)
+        if enabled == self.mclock_enabled:
+            return
+        self.mclock_enabled = enabled
+        for shard in range(self.num_shards):
+            if enabled:
+                items = []
+                for klass, q in self._queues[shard].items():
+                    while q:
+                        key, obj, work, entity, nbytes, seq = \
+                            q.popleft()
+                        items.append((seq, entity,
+                                      (key, obj, work, klass,
+                                       nbytes, seq)))
+                for seq, entity, item in sorted(items,
+                                                key=lambda t: t[0]):
+                    klass = item[3]
+                    self.sched.entity(entity, klass).queued += 1
+                    self._ent_queues[shard].setdefault(
+                        entity, collections.deque()).append(item)
+            else:
+                items = []
+                for entity, q in self._ent_queues[shard].items():
+                    while q:
+                        key, obj, work, klass, nbytes, seq = \
+                            q.popleft()
+                        self.sched.note_drop(entity)
+                        items.append((seq,
+                                      (key, obj, work, entity,
+                                       nbytes, seq), klass))
+                self._ent_queues[shard].clear()
+                for seq, item, klass in sorted(items,
+                                               key=lambda t: t[0]):
+                    if klass not in self._weights:
+                        self._register_class(klass)
+                    self._queues[shard][klass].append(item)
+                self._defer[shard] = None
+            self._wake[shard].set()
+        flight.record("qos_toggle", self.name, enabled=enabled)
+
+    def configure_qos(self, **kw) -> None:
+        """Forward knob values to the scheduler (config observer path)
+        and re-arbitrate: a loosened limit must unblock a deferred
+        shard without waiting out its old sleep."""
+        self.sched.configure(**kw)
+        for ev in self._wake:
+            ev.set()
+
+    def qos_status(self) -> dict:
+        """Admin-socket `qos status` body."""
+        st = self.sched.status()
+        st["enabled"] = self.mclock_enabled
+        st["deferred_waits"] = self.deferred_waits
+        st["queued"] = {
+            "legacy": sum(len(q) for shard in self._queues
+                          for q in shard.values()),
+            "mclock": sum(len(q) for shard in self._ent_queues
+                          for q in shard.values())}
+        return st
+
     def total_in_flight(self) -> int:
         """Items currently in pipelined execution across all shards."""
         return self._inflight_total
@@ -776,13 +880,52 @@ class ShardedOpQueue:
         return st.total if st is not None else 0
 
     def enqueue(self, key, work: Callable[[], Awaitable],
-                klass: str = "client", obj=None) -> None:
+                klass: str = "client", obj=None, entity: str | None = None,
+                nbytes: int = 0) -> bool:
         """Queue an async thunk on the shard owning `key`. `obj` names
         the object stream the item belongs to (same-obj items stay
-        FIFO); None makes the item an exclusive barrier for its key."""
+        FIFO); None makes the item an exclusive barrier for its key.
+        `entity` is the QoS accounting identity (client tenant;
+        background classes default to a class pseudo-entity) and
+        `nbytes` its payload size for byte-cost normalization.
+
+        Returns False when admission control SHED the op (dmclock mode,
+        shed policy, entity backlog past the depth cap) — the caller
+        owes the client an EAGAIN-style throttle reply. Always True on
+        the legacy path."""
         shard = self.shard_of(key)
-        self._queues[shard][klass].append((key, obj, work))
+        if entity is None:
+            entity = f"class:{klass}" if klass != "client" else "client"
+        self._seq += 1
+        if self.mclock_enabled:
+            if not self.sched.note_enqueue(entity, klass):
+                if self.perf is not None:
+                    self.perf.inc("qos_shed")
+                flight.record("qos_shed", self.name, tenant=entity,
+                              klass=klass,
+                              depth=self.sched.shed_queue_depth)
+                return False
+            self._ent_queues[shard].setdefault(
+                entity, collections.deque()).append(
+                (key, obj, work, klass, nbytes, self._seq))
+        else:
+            if klass not in self._weights:
+                self._register_class(klass)
+            self._queues[shard][klass].append(
+                (key, obj, work, entity, nbytes, self._seq))
         self._wake[shard].set()
+        return True
+
+    def _register_class(self, klass: str) -> None:
+        """A producer enqueued a class no profile declared: register it
+        late (wrr=1 best-effort) on every shard rather than KeyError —
+        see QosProfile.ensure."""
+        self.profile.ensure(klass)
+        self._weights = self.profile.wrr_weights()
+        for shard in range(self.num_shards):
+            self._queues[shard].setdefault(klass, collections.deque())
+            self._credits[shard].setdefault(
+                klass, self._weights[klass])
 
     # -- admission -----------------------------------------------------------
 
@@ -811,14 +954,15 @@ class ShardedOpQueue:
         skipped without rescanning."""
         blocked_keys: set = set()
         blocked_objs: set = set()
-        for i, (key, obj, work) in enumerate(q):
+        for i, item in enumerate(q):
+            key, obj = item[0], item[1]
             if key in blocked_keys:
                 continue
             if obj is not None and (key, obj) in blocked_objs:
                 continue
             if self._startable(infl, key, obj, klass, depth):
                 del q[i]
-                return key, obj, work
+                return item
             if obj is None:
                 # a waiting barrier: nothing behind it for this key
                 # may overtake (it is a sync point)
@@ -866,6 +1010,8 @@ class ShardedOpQueue:
         class before knowing the item could run); refill when no
         credited class can start anything. Sets the shard's stall flag
         when queued work existed but every item was window-blocked."""
+        if self.mclock_enabled:
+            return self._pick_mclock(shard)
         queues, credits = self._queues[shard], self._credits[shard]
         infl = self._inflight[shard]
         depth = self.pipeline_depth
@@ -873,7 +1019,7 @@ class ShardedOpQueue:
         blocked = False
         for attempt in range(2):
             blocked = False
-            for klass in self.WEIGHTS:
+            for klass in self._weights:
                 if not queues[klass] or credits[klass] <= 0:
                     continue
                 item = self._scan(queues[klass], infl, klass, depth)
@@ -883,14 +1029,97 @@ class ShardedOpQueue:
                 credits[klass] -= 1
                 self.processed_by_class[klass] += 1
                 self._admit(shard, klass, *item[:2])
-                return (klass, *item)
+                return (klass, *item[:3])
             # nothing admitted on credits: refill and retry once (an
             # uncredited class may hold startable work); a second dry
             # pass with blocked work means everything queued is
             # window-blocked
-            self._credits[shard] = dict(self.WEIGHTS)
+            self._credits[shard] = dict(self._weights)
             credits = self._credits[shard]
         self._stalled[shard] = blocked
+        return None
+
+    def _pick_mclock(self, shard: int) -> tuple | None:
+        """dmclock admission: the scheduler orders entities by tag
+        clocks; the first entity whose head-of-queue survives the
+        ordering windows admits. Window semantics (same-obj FIFO,
+        obj=None barriers) are enforced per entity queue by the same
+        _scan shadowing — see the class docstring for the ordering
+        contract. Sets the shard's defer hint when every queued entity
+        is limit-blocked (backpressure sleep)."""
+        queues = self._ent_queues[shard]
+        infl = self._inflight[shard]
+        depth = self.pipeline_depth
+        self._stalled[shard] = False
+        self._defer[shard] = None
+        ready = [e for e, q in queues.items() if q]
+        if not ready:
+            return None
+        order, defer_s, defer_ent = self.sched.schedule(ready)
+        if not order and self._stopping:
+            # shutdown drains ignore limit tags: stop() must not wait
+            # out a throttle horizon to finish queued work
+            order, defer_s = [(e, "weight") for e in sorted(ready)], None
+        blocked = False
+        for entity, phase in order:
+            q = queues.get(entity)
+            if not q:
+                continue
+            item = self._scan_entity(q, infl, depth)
+            if item is None:
+                blocked = True
+                continue
+            key, obj, work, klass, nbytes, _seq = item
+            if not q:
+                del queues[entity]
+            self.sched.charge(entity, self.sched.cost_of(nbytes),
+                              phase=phase)
+            if self.perf is not None:
+                self.perf.inc("qos_dequeue_reservation"
+                              if phase == "reservation"
+                              else "qos_dequeue_weight")
+            self.processed_by_class[klass] += 1
+            self._admit(shard, klass, key, obj)
+            return (klass, key, obj, work)
+        if defer_s is not None:
+            self._defer[shard] = defer_s
+            self.deferred_waits += 1
+            if self.perf is not None:
+                self.perf.inc("qos_deferred_waits")
+            now = time.monotonic()
+            if now - self._last_defer_flight >= 0.5:
+                self._last_defer_flight = now
+                flight.record("qos_backpressure", self.name,
+                              shard=shard, tenant=defer_ent,
+                              defer_ms=round(defer_s * 1000, 3))
+        self._stalled[shard] = blocked
+        return None
+
+    def _scan_entity(self, q: collections.deque, infl: dict,
+                     depth: int) -> tuple | None:
+        """_scan for a per-entity queue: items carry their own class
+        (an entity queue is single-class in practice, but the window
+        check keys on the item's class either way)."""
+        blocked_keys: set = set()
+        blocked_objs: set = set()
+        for i, item in enumerate(q):
+            key, obj, klass = item[0], item[1], item[3]
+            if key in blocked_keys:
+                continue
+            if obj is not None and (key, obj) in blocked_objs:
+                continue
+            if self._startable(infl, key, obj, klass, depth):
+                del q[i]
+                return item
+            if obj is None:
+                blocked_keys.add(key)
+                continue
+            st = infl.get(key)
+            if st is not None and (st.exclusive
+                                   or st.counts[klass] >= depth):
+                blocked_keys.add(key)
+            else:
+                blocked_objs.add((key, obj))
         return None
 
     async def _run_one(self, shard: int, klass: str, key, obj,
@@ -904,19 +1133,21 @@ class ShardedOpQueue:
             self.processed += 1
             self._complete(shard, klass, key, obj)
 
+    def _shard_empty(self, shard: int) -> bool:
+        return not any(self._queues[shard].values()) and \
+            not any(self._ent_queues[shard].values())
+
     async def _worker(self, shard: int) -> None:
         loop = asyncio.get_running_loop()
         while True:
             picked = self._pick(shard)
             if picked is None:
-                if self._stopping and \
-                        not any(self._queues[shard].values()):
+                if self._stopping and self._shard_empty(shard):
                     return
                 self._wake[shard].clear()
                 picked = self._pick(shard)      # close the enqueue race
             if picked is None:
-                if self._stopping and \
-                        not any(self._queues[shard].values()):
+                if self._stopping and self._shard_empty(shard):
                     return
                 if self._stalled[shard]:
                     # queued work exists but every item is blocked
@@ -931,6 +1162,19 @@ class ShardedOpQueue:
                             "pg_window_stall", self.name, shard=shard,
                             stalls=self.window_stalls,
                             depth=self.pipeline_depth)
+                defer = self._defer[shard]
+                if defer is not None:
+                    # backpressure: every queued entity is at its
+                    # limit — sleep until the earliest l_tag matures
+                    # (or an enqueue/completion wakes us early), then
+                    # re-arbitrate
+                    try:
+                        await asyncio.wait_for(
+                            self._wake[shard].wait(),
+                            timeout=min(defer, 1.0))
+                    except (asyncio.TimeoutError, TimeoutError):
+                        pass
+                    continue
                 await self._wake[shard].wait()
                 continue
             klass, key, obj, work = picked

@@ -1,0 +1,15 @@
+"""Known-positive decl-use: the QoS-scheduler surface rotted — an
+mclock knob no code path reads (retuning the reservation changes
+nothing) and a per-tenant QoS counter that would graph forever-zero."""
+
+
+class PerfCounters:        # base stub: the lint keys on the base NAME
+    pass
+
+
+class GhostQosCounters(PerfCounters):
+    def __init__(self, config, Option):
+        config.declare(Option("osd_mclock_ghost_reservation", "float",
+                              4.0, "a tag-clock knob nobody consults"))
+        self.add("qos_ghost_sheds",
+                 description="shed counter never incremented")

@@ -1,0 +1,42 @@
+"""Known-negative decl-use: the QoS-scheduler surface declared the way
+osd/daemon.py + utils/work_queue.py really declare it — the mclock
+knobs read at arm time AND hot-applied through an observer, and the
+per-tenant QoS counters declared on the daemon's perf handle and
+incremented on the shed/defer admission paths."""
+
+
+def register_config(config, Option, queue):
+    config.declare(Option("osd_mclock_enabled", "bool", False,
+                          "applied via the observer below"))
+    config.declare(Option("osd_mclock_client_reservation", "float", 0.0,
+                          "re-armed hot through the observer"))
+    queue.set_mclock_enabled(config.get("osd_mclock_enabled"))
+    queue.configure_qos(
+        client_reservation=config.get("osd_mclock_client_reservation"))
+
+    def _on_change(name, value):
+        if name == "osd_mclock_enabled":
+            queue.set_mclock_enabled(bool(value))
+        else:
+            queue.configure_qos(client_reservation=float(value))
+
+    config.add_observer(("osd_mclock_enabled",
+                         "osd_mclock_client_reservation"), _on_change)
+
+
+class Queue:
+    """Shed/defer accounting against the daemon's perf counters: a
+    refused enqueue records the shed, a limit-blocked pick the wait."""
+
+    def __init__(self, perf):
+        self.perf = perf
+        self.perf.add("qos_shed",
+                      description="incremented on every refusal below")
+        self.perf.add("qos_deferred_waits",
+                      description="incremented on limit-blocked parks")
+
+    def refuse(self):
+        self.perf.inc("qos_shed")
+
+    def park(self):
+        self.perf.inc("qos_deferred_waits")

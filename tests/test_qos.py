@@ -1,0 +1,481 @@
+"""dmclock QoS scheduler: tag math, admission control, and the
+ShardedOpQueue integration (osd/scheduler/, PR 18 tentpole).
+
+Contracts under test (src/osd/scheduler/mClockScheduler.h analog):
+reservation is a strict-priority floor, limit is a hard ceiling that
+defers (backpressure) or refuses (shed), weight splits the excess
+proportionally, cost is byte-normalized, and the whole arbitration is
+deterministic under an injected clock. The legacy WRR path must stay
+bit-identical with the scheduler off (test_op_queue.py asserts the
+exact interleave; here we assert toggle migration loses nothing).
+"""
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.osd.scheduler import MClockScheduler, default_profile
+from ceph_tpu.utils.work_queue import ShardedOpQueue
+
+from tests.test_cluster import run  # noqa: F401
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _sched(**kw) -> tuple[MClockScheduler, FakeClock]:
+    clk = FakeClock()
+    s = MClockScheduler(default_profile(), clock=clk)
+    if kw:
+        s.configure(**kw)
+    return s, clk
+
+
+# -- tag math ----------------------------------------------------------------
+
+def test_cost_is_byte_normalized():
+    s, _ = _sched(cost_per_io_bytes=65536)
+    assert s.cost_of(0) == 1.0
+    assert s.cost_of(65536) == 2.0
+    # a 256 KiB streamer op pays 5x a metadata op
+    assert s.cost_of(262144) == 5.0
+
+
+def test_reservation_phase_outranks_weight_phase():
+    """An entity behind its guaranteed rate is served first even when
+    its proportional tag is far behind the competition's."""
+    s, clk = _sched(client_reservation=0.0, client_weight=1.0)
+    s.note_enqueue("bully", "client")
+    s.note_enqueue("class:recovery", "recovery")   # reservation=4.0
+    # run the bully's p_tag way ahead (it has been served a lot)
+    for _ in range(10):
+        s.charge("bully", 1.0)
+    clk.advance(1.0)
+    s.note_enqueue("bully", "client")
+    order, defer, _ = s.schedule(["bully", "class:recovery"])
+    assert defer is None
+    assert order[0] == ("class:recovery", "reservation")
+
+
+def test_every_service_advances_the_reservation_clock():
+    """Weight-phase service counts toward the reservation (the dmclock
+    R-tag adjustment): a reservation is a floor, not a bonus."""
+    s, clk = _sched(client_reservation=2.0)
+    s.note_enqueue("t0", "client")
+    e = s._ents["t0"]
+    r0 = e.r_tag
+    s.charge("t0", 1.0, phase="weight")
+    assert e.r_tag == r0 + 0.5          # cost/reservation = 1/2
+    # once r_tag is in the future the entity leaves reservation phase
+    s.note_enqueue("t0", "client")
+    order, _, _ = s.schedule(["t0"])
+    assert order == [("t0", "weight")]
+
+
+def test_limit_defers_and_reports_the_blocker():
+    s, clk = _sched(client_limit=2.0)       # 2 cost units / second
+    s.note_enqueue("t0", "client")
+    s.charge("t0", 4.0)                     # l_tag now 2s in the future
+    s.note_enqueue("t0", "client")
+    order, defer, who = s.schedule(["t0"])
+    assert order == [] and who == "t0"
+    assert abs(defer - 2.0) < 1e-9
+    assert s.total_deferred == 1 and s._ents["t0"].deferred == 1
+    clk.advance(2.0)                        # the l_tag matures
+    order, defer, _ = s.schedule(["t0"])
+    assert order == [("t0", "weight")] and defer is None
+
+
+def test_reservation_phase_ignores_the_limit():
+    """reservation <= limit is the operator's contract: a guarantee a
+    cap could veto would be no guarantee."""
+    s, _ = _sched(client_reservation=1.0, client_limit=2.0)
+    s.note_enqueue("t0", "client")
+    e = s._ents["t0"]
+    e.l_tag += 100.0                        # hard limit-blocked
+    order, _, _ = s.schedule(["t0"])
+    assert order == [("t0", "reservation")]
+
+
+def test_weight_splits_capacity_proportionally():
+    """2:1 weights -> 2:1 service split over a backlogged pair."""
+    s, _ = _sched(client_weight=1.0,
+                  tenant_profiles={"heavy": {"weight": 2.0}})
+    for name in ("heavy", "light"):
+        for _ in range(30):
+            s.note_enqueue(name, "client")
+    served = {"heavy": 0, "light": 0}
+    for _ in range(30):
+        order, _, _ = s.schedule(["heavy", "light"])
+        winner = order[0][0]
+        served[winner] += 1
+        s.charge(winner, 1.0, phase=order[0][1])
+    assert served["heavy"] == 20 and served["light"] == 10
+
+
+def test_shed_past_depth_cap_but_never_background():
+    s, _ = _sched(overload_policy="shed", shed_queue_depth=2)
+    assert s.note_enqueue("t0", "client")
+    assert s.note_enqueue("t0", "client")
+    assert not s.note_enqueue("t0", "client")      # depth cap
+    assert s._ents["t0"].shed == 1 and s.total_shed == 1
+    # other tenants are unaffected; background classes are never shed
+    assert s.note_enqueue("t1", "client")
+    for _ in range(5):
+        assert s.note_enqueue("class:recovery", "recovery")
+
+
+def test_hot_knob_change_rebinds_live_entities():
+    s, _ = _sched(client_limit=0.0)
+    s.note_enqueue("t0", "client")
+    assert s._ents["t0"].limit == 0.0
+    s.configure(client_limit=8.0,
+                tenant_profiles={"t0": {"limit": 4.0}})
+    assert s._ents["t0"].limit == 4.0       # override wins
+    s.configure(tenant_profiles={})
+    assert s._ents["t0"].limit == 8.0
+
+
+def test_schedule_is_deterministic_under_injected_clock():
+    def trace():
+        s, clk = _sched(client_reservation=1.0, client_limit=10.0)
+        out = []
+        for i, name in enumerate(["a", "b", "c"] * 4):
+            s.note_enqueue(name, "client")
+        for _ in range(12):
+            order, defer, _ = s.schedule(["a", "b", "c"])
+            if not order:
+                clk.advance(defer)
+                continue
+            name, phase = order[0]
+            out.append((name, phase))
+            s.charge(name, 1.5, phase=phase)
+            clk.advance(0.01)
+        return out
+    assert trace() == trace()
+
+
+# -- queue integration -------------------------------------------------------
+
+def test_queue_mclock_weighted_fairness():
+    """One backlogged shard, equal-weight tenants with unequal
+    backlogs: the dequeue interleave alternates instead of serving the
+    first tenant's FIFO to exhaustion."""
+    async def body():
+        q = ShardedOpQueue(num_shards=1, clock=FakeClock())
+        q.set_mclock_enabled(True)
+        order: list[str] = []
+
+        async def item(t):
+            order.append(t)
+
+        for _ in range(12):
+            q.enqueue("k", lambda: item("bully"), entity="bully")
+        for _ in range(4):
+            q.enqueue("k", lambda: item("meek"), entity="meek")
+        q.start()
+        deadline = asyncio.get_running_loop().time() + 5
+        while len(order) < 16:
+            assert asyncio.get_running_loop().time() < deadline, order
+            await asyncio.sleep(0.01)
+        await q.stop()
+        # while both are backlogged (first 8 services), strict
+        # alternation by p_tag with name tie-break
+        assert order[:8] == ["bully", "meek"] * 4, order
+        assert order.count("bully") == 12 and order.count("meek") == 4
+    run(body())
+
+
+def test_queue_byte_cost_dethrottles_streamer():
+    """Equal op counts, 64 KiB vs 0-byte payloads: the streamer's
+    p_tag advances ~2x per op, so the spammer gets ~2 services per
+    streamer service once both are backlogged."""
+    async def body():
+        q = ShardedOpQueue(num_shards=1, clock=FakeClock())
+        q.set_mclock_enabled(True)
+        order: list[str] = []
+
+        async def item(t):
+            order.append(t)
+
+        for _ in range(10):
+            q.enqueue("k", lambda: item("streamer"), entity="streamer",
+                      nbytes=65536)
+            q.enqueue("k", lambda: item("spammer"), entity="spammer",
+                      nbytes=0)
+        q.start()
+        deadline = asyncio.get_running_loop().time() + 5
+        while len(order) < 20:
+            assert asyncio.get_running_loop().time() < deadline, order
+            await asyncio.sleep(0.01)
+        await q.stop()
+        # in the first 9 services the 2-cost streamer got at most 1
+        # service per 2 spammer services (plus the seed service)
+        head = order[:9]
+        assert head.count("spammer") >= 2 * head.count("streamer") - 2, \
+            order
+    run(body())
+
+
+def test_queue_shed_returns_false_and_counts():
+    async def body():
+        q = ShardedOpQueue(num_shards=1)
+        q.set_mclock_enabled(True)
+        q.configure_qos(overload_policy="shed", shed_queue_depth=2)
+
+        async def noop():
+            pass
+
+        assert q.enqueue("k", noop, entity="t0")
+        assert q.enqueue("k", noop, entity="t0")
+        assert not q.enqueue("k", noop, entity="t0")
+        st = q.qos_status()
+        assert st["total_shed"] == 1
+        assert st["entities"]["t0"]["shed"] == 1
+        q.start()
+        deadline = asyncio.get_running_loop().time() + 5
+        while q.processed < 2:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        await q.stop()
+    run(body())
+
+
+def test_queue_backpressure_bounds_rate_then_drains():
+    """A tight limit defers dequeues (timed sleeps, not a spin): the
+    backlog drains at the limit rate and the deferred-wait ledger
+    counts the sleeps."""
+    async def body():
+        q = ShardedOpQueue(num_shards=1)
+        q.set_mclock_enabled(True)
+        q.configure_qos(client_limit=50.0)      # 50 cost units/s
+        done: list[float] = []
+        loop = asyncio.get_running_loop()
+
+        async def item():
+            done.append(loop.time())
+
+        t0 = loop.time()
+        for _ in range(10):
+            q.enqueue("k", item, entity="t0")
+        q.start()
+        deadline = loop.time() + 5
+        while len(done) < 10:
+            assert loop.time() < deadline
+            await asyncio.sleep(0.01)
+        await q.stop()
+        # 10 unit-cost ops at 50/s: the tail op cannot land before
+        # ~(10-1)/50 s after the first service
+        assert done[-1] - t0 >= 0.12, done[-1] - t0
+        assert q.deferred_waits > 0
+        assert q.qos_status()["total_deferred"] > 0
+    run(body())
+
+
+def test_queue_recovery_reservation_under_client_flood():
+    """The recovery pseudo-entity's reservation admits it promptly
+    through a 50-op client backlog (the starvation the static WRR
+    weights could not prevent is now a guaranteed rate)."""
+    async def body():
+        q = ShardedOpQueue(num_shards=1, clock=FakeClock())
+        q.set_mclock_enabled(True)
+        order: list[str] = []
+
+        async def item(t):
+            order.append(t)
+
+        for i in range(50):
+            q.enqueue("k", lambda: item("c"), entity="bully",
+                      obj=f"o{i}")
+        q.enqueue("k", lambda: item("R"), klass="recovery",
+                  obj="rec-obj")
+        q.start()
+        deadline = asyncio.get_running_loop().time() + 5
+        while len(order) < 51:
+            assert asyncio.get_running_loop().time() < deadline, order
+            await asyncio.sleep(0.01)
+        await q.stop()
+        # reservation phase runs it long before the backlog drains
+        assert order.index("R") <= 2, order.index("R")
+    run(body())
+
+
+def test_queue_toggle_migration_preserves_order_and_work():
+    """Hot-toggling the scheduler with queued work migrates every item
+    between the class and entity queues, preserving per-entity arrival
+    order — nothing lost, nothing reordered within a tenant."""
+    async def body():
+        q = ShardedOpQueue(num_shards=1)
+        order: list[tuple[str, int]] = []
+
+        async def item(t, i):
+            order.append((t, i))
+
+        for i in range(6):
+            q.enqueue("k", lambda i=i: item("t0", i), entity="t0")
+            q.enqueue("k", lambda i=i: item("t1", i), entity="t1")
+        q.set_mclock_enabled(True)          # migrate legacy -> entity
+        assert q.qos_status()["queued"] == {"legacy": 0, "mclock": 12}
+        q.set_mclock_enabled(False)         # and back
+        assert q.qos_status()["queued"] == {"legacy": 12, "mclock": 0}
+        q.set_mclock_enabled(True)
+        q.start()
+        deadline = asyncio.get_running_loop().time() + 5
+        while len(order) < 12:
+            assert asyncio.get_running_loop().time() < deadline, order
+            await asyncio.sleep(0.01)
+        await q.stop()
+        assert [i for t, i in order if t == "t0"] == list(range(6))
+        assert [i for t, i in order if t == "t1"] == list(range(6))
+        assert q.processed == 12
+    run(body())
+
+
+def test_queue_mclock_respects_object_windows():
+    """QoS arbitration never violates the execution windows: same-obj
+    items of one tenant stay FIFO and never overlap, and a blocked
+    head lets ANOTHER tenant through (work conservation) rather than
+    stalling the shard."""
+    async def body():
+        q = ShardedOpQueue(num_shards=1, pipeline_depth=2)
+        q.set_mclock_enabled(True)
+        log: list[str] = []
+        gate = asyncio.Event()
+
+        async def blocked(tag):
+            log.append(f"start:{tag}")
+            await gate.wait()
+            log.append(f"end:{tag}")
+
+        async def quick(tag):
+            log.append(f"start:{tag}")
+            log.append(f"end:{tag}")
+
+        q.enqueue("k", lambda: blocked("a1"), entity="ta", obj="x")
+        q.enqueue("k", lambda: blocked("a2"), entity="ta", obj="x")
+        q.enqueue("k", lambda: quick("b1"), entity="tb", obj="y")
+        q.start()
+        await asyncio.sleep(0.05)
+        # a2 is same-obj-blocked behind a1; tb overtook through the
+        # free window slot
+        assert "start:a1" in log and "end:b1" in log
+        assert "start:a2" not in log, log
+        gate.set()
+        deadline = asyncio.get_running_loop().time() + 5
+        while q.processed < 3:
+            assert asyncio.get_running_loop().time() < deadline, log
+            await asyncio.sleep(0.01)
+        await q.stop()
+        assert log.index("start:a1") < log.index("start:a2")
+    run(body())
+
+
+def test_profile_replaces_hardcoded_weights():
+    """Satellite fix: classes are declared in the profile; the phantom
+    `scrub` class is gone from the default, and an undeclared producer
+    class late-registers instead of KeyError-ing."""
+    prof = default_profile()
+    assert set(prof.wrr_weights()) == {"client", "recovery"}
+    assert ShardedOpQueue.WEIGHTS == {"client": 4, "recovery": 1}
+
+    async def body():
+        q = ShardedOpQueue(num_shards=1)
+        ran = asyncio.Event()
+
+        async def item():
+            ran.set()
+
+        q.enqueue("k", item, klass="deep-scrub")    # undeclared class
+        assert q.profile.spec("deep-scrub").background
+        q.start()
+        await asyncio.wait_for(ran.wait(), 5)
+        await q.stop()
+    run(body())
+
+
+# -- interleave tier: arbitration determinism --------------------------------
+
+@pytest.mark.interleave
+def test_mclock_dequeue_order_deterministic_per_seed():
+    """Tag-clock arbitration is schedule-deterministic: producers race
+    the drain under the explorer, yet the same seed replays the exact
+    dequeue order and schedule digest — tie-breaks never fall back on
+    dict order or wall-clock."""
+    from ceph_tpu.qa import interleave
+
+    async def trial(seed: int):
+        async with interleave.explore(seed) as ex:
+            q = ShardedOpQueue(num_shards=1, clock=FakeClock())
+            q.set_mclock_enabled(True)
+            q.configure_qos(
+                tenant_profiles={"ta": {"weight": 2.0},
+                                 "tb": {"reservation": 3.0}})
+            order: list[str] = []
+
+            async def item(t):
+                order.append(t)
+
+            async def producer(t, n, nbytes):
+                for _ in range(n):
+                    q.enqueue("k", lambda: item(t), entity=t,
+                              nbytes=nbytes)
+                    await asyncio.sleep(0)
+
+            q.start()
+            await asyncio.gather(producer("ta", 6, 0),
+                                 producer("tb", 6, 65536),
+                                 producer("tc", 6, 0))
+            deadline = asyncio.get_running_loop().time() + 5
+            while len(order) < 18:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            await q.stop()
+            return tuple(order), ex.digest()
+
+    for seed in range(1, 6):
+        a = run(trial(seed))
+        b = run(trial(seed))
+        assert a == b, f"seed {seed} diverged"
+
+
+@pytest.mark.interleave
+def test_mclock_disabled_is_bit_identical_wrr_under_explorer():
+    """`osd_mclock_enabled=false` IS the legacy path: across explorer
+    seeds the dequeue interleave stays the exact static-WRR pattern
+    test_op_queue.py pins (w client then 1 recovery), schedule noise
+    notwithstanding."""
+    from ceph_tpu.qa import interleave
+
+    w = ShardedOpQueue.WEIGHTS["client"]
+
+    async def trial(seed: int):
+        async with interleave.explore(seed):
+            q = ShardedOpQueue(num_shards=1)
+            order: list[str] = []
+
+            async def item(t):
+                order.append(t)
+
+            for _ in range(2 * w):
+                q.enqueue("k", lambda: item("c"), klass="client")
+            for _ in range(2):
+                q.enqueue("k", lambda: item("r"), klass="recovery")
+            q.start()
+            deadline = asyncio.get_running_loop().time() + 5
+            while len(order) < 2 * w + 2:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            await q.stop()
+            return order
+
+    for seed in range(1, 6):
+        assert run(trial(seed)) == (["c"] * w + ["r"]) * 2

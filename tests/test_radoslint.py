@@ -50,6 +50,8 @@ def lint(path, rules):
      "decl_use_clients_good.py"),
     ("decl-use", "decl_use_pipeline_bad.py", 2,
      "decl_use_pipeline_good.py"),
+    ("decl-use", "decl_use_qos_bad.py", 2,
+     "decl_use_qos_good.py"),
     ("decl-use", "decl_use_flight_bad.py", 2,
      "decl_use_flight_good.py"),
     ("decl-use", "decl_use_tracer_bad.py", 2,
