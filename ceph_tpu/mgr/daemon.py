@@ -266,6 +266,36 @@ class DaemonStateIndex:
                         e[f] += v
         return agg
 
+    #: numeric per-pool scrub fields summed in the cross-OSD merge
+    _SCRUB_SUM_FIELDS = ("objects_scrubbed", "bytes_hashed",
+                         "errors_found", "errors_repaired",
+                         "inconsistent", "unrepaired")
+
+    def scrub_aggregate(self) -> dict[str, dict]:
+        """Cross-OSD merge per pool: a pool's PGs spread their primaries
+        over the cluster, so its scrub ledger (objects/bytes scanned,
+        errors found/repaired, inconsistent registry counts) is the SUM
+        of each reporting OSD's per-pool table; the freshness ages are
+        the cluster-wide WORST (max)."""
+        agg: dict[str, dict] = {}
+        for _name, st in sorted(self.daemons.items()):
+            sc = (st.health_metrics or {}).get("scrub") or {}
+            for pool, d in (sc.get("pools") or {}).items():
+                if not isinstance(d, dict):
+                    continue
+                e = agg.setdefault(str(pool), dict.fromkeys(
+                    self._SCRUB_SUM_FIELDS, 0))
+                for f in self._SCRUB_SUM_FIELDS:
+                    v = d.get(f)
+                    if isinstance(v, (int, float)) and \
+                            not isinstance(v, bool):
+                        e[f] += v
+                for f in ("last_scrub_age_s", "last_deep_scrub_age_s"):
+                    v = d.get(f)
+                    if isinstance(v, (int, float)) and v >= 0:
+                        e[f] = max(e.get(f, -1.0), v)
+        return agg
+
     #: numeric per-client fields summed in the cross-OSD merge
     _CLIENT_SUM_FIELDS = ("ops", "read_ops", "write_ops", "read_bytes",
                           "written_bytes", "in_flight", "slo_good",
@@ -675,6 +705,9 @@ class MgrDaemon(Dispatcher):
                 status["client_table"] = dict(sorted(
                     agg.items(),
                     key=lambda kv: -kv[1].get("ops", 0))[:15])
+                # per-pool integrity ledger for the dashboard scrub row
+                status["scrub_table"] = \
+                    self.daemon_index.scrub_aggregate()
                 # dashboard sparkline feed: the most recently moving
                 # history series (windowed p99 for histograms, rates
                 # for counters), rendered as unicode microcharts
@@ -865,6 +898,10 @@ class MgrDaemon(Dispatcher):
         nearfull, full = [], []
         offload_degraded = []
         crashed = []
+        # scrub integrity surface: registry-backed, so the checks raise
+        # at detection and clear after the next verified-clean round
+        scrub_err = []          # (daemon, inconsistent, unrepaired)
+        damaged_pgs = 0
         # per-client SLO surface (OpTracker ClientTable health metrics)
         slo_total = 0
         slo_clients: dict[str, int] = {}
@@ -909,6 +946,12 @@ class MgrDaemon(Dispatcher):
                 if cur is None or float(s.get("p99_ms") or 0.0) \
                         > float(cur.get("p99_ms") or 0.0):
                     slow_clients[c] = dict(s, osd=name)
+            sc = hm.get("scrub") or {}
+            if sc.get("inconsistent_objects"):
+                scrub_err.append((name,
+                                  int(sc["inconsistent_objects"]),
+                                  int(sc.get("unrepaired_objects") or 0)))
+            damaged_pgs += int(sc.get("inconsistent_pgs") or 0)
             store = hm.get("store") or {}
             util = float(store.get("utilization") or 0.0)
             if util >= self.FULL_RATIO:
@@ -979,6 +1022,27 @@ class MgrDaemon(Dispatcher):
                            f"{s.get('p99_ms')}ms vs slo "
                            f"{s.get('slo_ms')}ms on {s.get('osd')}"
                            for c, s in sorted(slow_clients.items())]}
+        if scrub_err:
+            # scrub found copies/shards disagreeing with their peers:
+            # data damage until a clean round retires the registry
+            # entries (primaries report their own PGs — counts sum)
+            total = sum(n for _, n, _ in scrub_err)
+            unrep = sum(u for _, _, u in scrub_err)
+            checks["OSD_SCRUB_ERRORS"] = {
+                "severity": "HEALTH_ERR",
+                "summary": f"{total} scrub errors"
+                           + (f" ({unrep} unrepaired)" if unrep else ""),
+                "detail": [f"{d}: {n} inconsistent objects"
+                           + (f", {u} unrepaired" if u else "")
+                           for d, n, u in scrub_err]}
+            checks["PG_DAMAGED"] = {
+                "severity": "HEALTH_ERR",
+                "summary": f"Possible data damage: {damaged_pgs} pg"
+                           f"{'s' if damaged_pgs != 1 else ''} "
+                           f"inconsistent",
+                "detail": [f"{d}: {n} objects in the inconsistent "
+                           f"registry (list-inconsistent-obj)"
+                           for d, n, _ in scrub_err]}
         if offload_degraded:
             # the EC data path still serves (host-codec fallback is
             # bit-identical) but at host speed: warn, don't err

@@ -211,6 +211,24 @@ def render_metrics(health: dict | None = None, index=None,
                     metric, {"type": "gauge" if field == "queued"
                              else "counter", "rows": []})
                 fam["rows"].append(f"{metric}{{{tlabel}}} {v}")
+        # per-pool scrub families (the continuous-integrity ledger):
+        # objects/bytes scanned and errors found/repaired merged ACROSS
+        # the pool's primaries, registry counts and freshness ages as
+        # gauges. Cardinality = pool count.
+        for pool, e in sorted(index.scrub_aggregate().items()):
+            plabel = f'pool="{_label_escape(str(pool))}"'
+            for field, v in sorted(e.items()):
+                if not isinstance(v, (int, float)) or \
+                        isinstance(v, bool):
+                    continue
+                metric = f"ceph_scrub_{_sanitize(field)}"
+                fam = families.setdefault(
+                    metric, {"type": "gauge" if field in
+                             ("inconsistent", "unrepaired",
+                              "last_scrub_age_s",
+                              "last_deep_scrub_age_s")
+                             else "counter", "rows": []})
+                fam["rows"].append(f"{metric}{{{plabel}}} {v}")
         fam = families.setdefault("ceph_daemon_report_age_seconds",
                                   {"type": "gauge", "rows": []})
         for daemon, age in index.report_ages().items():
@@ -375,6 +393,24 @@ def render_dashboard(status: dict, health: dict | None) -> str:
                     "<th>write p99 (ms)</th><th>SLO viol</th></tr>"
                     + "".join(client_rows) + "</table>"
                     if client_rows else "")
+    # per-pool scrub table (the continuous-integrity ledger): scan
+    # volume, errors found/repaired, and the live inconsistent registry
+    scrub_rows = []
+    for pname, se in sorted((status.get("scrub_table") or {}).items()):
+        scrub_rows.append(
+            f"<tr><td>{esc(str(pname))}</td>"
+            f"<td>{se.get('objects_scrubbed', 0)}</td>"
+            f"<td>{se.get('bytes_hashed', 0) / 1e6:.1f}</td>"
+            f"<td>{se.get('errors_found', 0)}</td>"
+            f"<td>{se.get('errors_repaired', 0)}</td>"
+            f"<td>{se.get('inconsistent', 0)}</td>"
+            f"<td>{se.get('unrepaired', 0)}</td></tr>")
+    scrub_html = ("<h2>scrub</h2><table><tr><th>pool</th>"
+                  "<th>objects</th><th>MB hashed</th><th>found</th>"
+                  "<th>repaired</th><th>inconsistent</th>"
+                  "<th>unrepaired</th></tr>"
+                  + "".join(scrub_rows) + "</table>"
+                  if scrub_rows else "")
     progress_items = []
     for ev in (status.get("progress_events")
                or status.get("progress") or []):
@@ -457,6 +493,7 @@ mons {', '.join(str(q) for q in
 {''.join(rows)}</table>
 {daemons_html}
 {clients_html}
+{scrub_html}
 {sparks_html}
 {progress_html}
 {slow_html}

@@ -338,6 +338,9 @@ MMgrMap = _simple(0xB4, "MMgrMap")              # mon -> subscriber push of the
 
 # -- scrub (MOSDRepScrub / replica scrub map, src/messages/MOSDRepScrub.h) ---
 MOSDRepScrub = _simple(0x80, "MOSDRepScrub")        # {"pgid", "tid", "from",
-                                                    #  "deep": bool}
+                                                    #  "deep": bool,
+                                                    #  "range": [lo, hi]}
+                                                    # lo/hi None = open end;
+                                                    # scan names lo < n <= hi
 MOSDRepScrubMap = _simple(0x81, "MOSDRepScrubMap")  # {"pgid", "tid", "from",
                                                     #  "map": {oid: entry}}

@@ -32,13 +32,14 @@ from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
 from ceph_tpu.mon.mon_client import MonClient
 from ceph_tpu.objectstore.memstore import MemStore
 from ceph_tpu.objectstore.store import StoreError
+from ceph_tpu.osd import scrub as scrub_mod
 from ceph_tpu.osd.backend import IntervalChange
 from ceph_tpu.osd.pg import PGInstance
 from ceph_tpu.qa import faultinject
 from ceph_tpu.utils import (copytrack, crash, flight, loopprof, sanitizer,
                             tracer)
 from ceph_tpu.utils.admin_socket import AdminSocket
-from ceph_tpu.utils.async_util import reap_all
+from ceph_tpu.utils.async_util import drain_all, reap_all
 from ceph_tpu.utils.config import Config, Option
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import (TYPE_AVG, TYPE_GAUGE,
@@ -89,6 +90,14 @@ class OSD(Dispatcher):
                    minimum=0.05),
             Option("osd_deep_scrub_every", "int", self.DEEP_SCRUB_EVERY,
                    "every Nth scrub round re-reads data", minimum=1),
+            Option("osd_scrub_chunk_max", "int", 32,
+                   "objects scanned per scrub chunk; each chunk costs "
+                   "one QoS grant under the scrub class, so smaller "
+                   "chunks yield to client I/O more often (hot: the "
+                   "next chunk re-reads it)", minimum=1),
+            Option("osd_scrub_sleep", "float", 0.0,
+                   "seconds slept between scrub scan chunks (throttle "
+                   "on top of the QoS pacing; hot)", minimum=0.0),
             Option("osd_op_num_shards", "int", self.NUM_OP_SHARDS,
                    "op queue shards (startup only)", minimum=1),
             Option("osd_max_recovery_in_flight", "int",
@@ -151,6 +160,25 @@ class OSD(Dispatcher):
             Option("osd_mclock_recovery_weight", "float", 0.5,
                    "recovery's proportional share of excess capacity",
                    minimum=0.0),
+            Option("osd_mclock_scrub_reservation", "float", 2.0,
+                   "guaranteed cost-units/sec for the scrub class "
+                   "pseudo-entity (nonzero keeps integrity scanning "
+                   "progressing under client floods)", minimum=0.0),
+            Option("osd_mclock_scrub_limit", "float", 0.0,
+                   "cost-units/sec cap for scrub (0 = uncapped)",
+                   minimum=0.0),
+            Option("osd_mclock_scrub_weight", "float", 0.25,
+                   "scrub's proportional share of excess capacity",
+                   minimum=0.0),
+            Option("osd_mclock_snaptrim_reservation", "float", 1.0,
+                   "guaranteed cost-units/sec for the snaptrim class "
+                   "pseudo-entity", minimum=0.0),
+            Option("osd_mclock_snaptrim_limit", "float", 0.0,
+                   "cost-units/sec cap for snaptrim (0 = uncapped)",
+                   minimum=0.0),
+            Option("osd_mclock_snaptrim_weight", "float", 0.25,
+                   "snaptrim's proportional share of excess capacity",
+                   minimum=0.0),
             Option("osd_mclock_overload_policy", "str", "backpressure",
                    "past-saturation admission control: backpressure "
                    "defers dequeue until limit tags mature; shed "
@@ -205,6 +233,7 @@ class OSD(Dispatcher):
         loopprof.perf()
         copytrack.perf()
         tracer.perf()
+        scrub_mod.scrub_perf()
         # per-daemon perf counters, served by `perf dump` (the admin
         # socket reads the process-wide collection)
         coll = PerfCountersCollection.instance()
@@ -302,6 +331,10 @@ class OSD(Dispatcher):
              "osd_mclock_client_limit", "osd_mclock_client_weight",
              "osd_mclock_recovery_reservation",
              "osd_mclock_recovery_limit", "osd_mclock_recovery_weight",
+             "osd_mclock_scrub_reservation",
+             "osd_mclock_scrub_limit", "osd_mclock_scrub_weight",
+             "osd_mclock_snaptrim_reservation",
+             "osd_mclock_snaptrim_limit", "osd_mclock_snaptrim_weight",
              "osd_mclock_overload_policy",
              "osd_mclock_shed_queue_depth",
              "osd_mclock_tenant_profiles"),
@@ -346,6 +379,11 @@ class OSD(Dispatcher):
                              if pg.last_scrub is not None},
                 "last scrub result per PG")
             self.asok.register_command(
+                "list-inconsistent-obj",
+                lambda req: self._list_inconsistent(req.get("pool")),
+                "per-PG inconsistent-object registry from the last "
+                "scrub rounds (optionally filtered by pool id)")
+            self.asok.register_command(
                 "status", lambda req: self._daemon_status(),
                 "daemon status")
             self.asok.register_command(
@@ -379,7 +417,7 @@ class OSD(Dispatcher):
             client_cb=self._mgr_client_metrics,
             qos_cb=self._mgr_qos_metrics,
             extra_loggers=("offload", "sanitizer", "loopprof",
-                           "copyflow", "msgr", "tracer"))
+                           "copyflow", "msgr", "tracer", "scrub"))
         # the per-loop offload service handle (set at start(): the
         # admin-socket thread cannot resolve the running loop itself)
         self._offload_svc = None
@@ -527,6 +565,10 @@ class OSD(Dispatcher):
                 # per-client SLO surface: recent violations + slow
                 # clients, digested into SLO_VIOLATIONS / SLOW_CLIENT
                 "clients": self.optracker.clients.health_metrics(),
+                # integrity surface: registry counts digested into
+                # PG_DAMAGED / OSD_SCRUB_ERRORS, per-pool table
+                # aggregated into the ceph_scrub_* exporter families
+                "scrub": self._scrub_health_metrics(),
                 "store": self.store.statfs()}
 
     def _mgr_device_metrics(self) -> dict:
@@ -626,7 +668,15 @@ class OSD(Dispatcher):
             class_params={"recovery": {
                 "reservation": cfg.get("osd_mclock_recovery_reservation"),
                 "limit": cfg.get("osd_mclock_recovery_limit"),
-                "weight": cfg.get("osd_mclock_recovery_weight")}})
+                "weight": cfg.get("osd_mclock_recovery_weight")},
+                "scrub": {
+                "reservation": cfg.get("osd_mclock_scrub_reservation"),
+                "limit": cfg.get("osd_mclock_scrub_limit"),
+                "weight": cfg.get("osd_mclock_scrub_weight")},
+                "snaptrim": {
+                "reservation": cfg.get("osd_mclock_snaptrim_reservation"),
+                "limit": cfg.get("osd_mclock_snaptrim_limit"),
+                "weight": cfg.get("osd_mclock_snaptrim_weight")}})
 
     def _on_qos_knobs(self, name: str, value) -> None:
         """osd_mclock_* observer: the enable toggle migrates queued
@@ -744,11 +794,24 @@ class OSD(Dispatcher):
                                f"{pg.pgid.pool}.{pg.pgid.ps}",
                     "progress": round(
                         max(0.0, (total - remaining)) / total, 4)})
+            prog = getattr(pg, "scrub_progress", None)
+            if prog is not None and prog.state == "scrubbing" \
+                    and prog.objects_total:
+                out.append({
+                    "id": f"scrub-{pg.pgid.pool}.{pg.pgid.ps}",
+                    "message": f"{'deep-' if prog.deep else ''}scrub of "
+                               f"pg {pg.pgid.pool}.{pg.pgid.ps}",
+                    "progress": round(
+                        min(prog.objects_scrubbed, prog.objects_total)
+                        / prog.objects_total, 4)})
         return out
 
-    def _trigger_scrub(self, deep: bool) -> dict:
-        n = 0
-        for pg in list(self.pgs.values()):
+    def _spawn_scrubs(self, deep: bool) -> dict[str, asyncio.Task]:
+        """One scrub task per primary active PG, each held in _bg_tasks
+        (reaped at stop(), failures crash-recorded) AND returned by
+        handle so callers can await real per-PG results."""
+        tasks: dict[str, asyncio.Task] = {}
+        for pgid, pg in list(self.pgs.items()):
             if pg.is_primary() and pg.state == "active":
                 task = asyncio.get_running_loop().create_task(
                     pg.scrub(deep=deep))
@@ -756,8 +819,100 @@ class OSD(Dispatcher):
                 # surface repair failures in the log
                 self._bg_tasks.add(task)
                 task.add_done_callback(self._bg_task_done)
-                n += 1
-        return {"scheduled": n, "deep": deep}
+                tasks[f"{pgid.pool}.{pgid.ps}"] = task
+        return tasks
+
+    def _trigger_scrub(self, deep: bool) -> dict:
+        """Kick a scrub of every primary PG. From the loop the tasks
+        are spawned inline; from an admin-socket thread the spawn hops
+        to the daemon's loop (tasks can only be created there) and the
+        reply lists the PGs that will be scheduled."""
+        try:
+            on_loop = asyncio.get_running_loop() is self._loop
+        except RuntimeError:
+            on_loop = False
+        if on_loop:
+            pgs = sorted(self._spawn_scrubs(deep))
+        else:
+            pgs = sorted(f"{pgid.pool}.{pgid.ps}"
+                         for pgid, pg in list(self.pgs.items())
+                         if pg.is_primary() and pg.state == "active")
+            self._run_on_loop(self._spawn_scrubs, deep)
+        return {"scheduled": len(pgs), "deep": deep, "pgs": pgs}
+
+    async def scrub_all(self, deep: bool = False) -> dict[str, dict]:
+        """Scrub every primary PG and return {pg: result} — the awaited
+        form of the fire-and-forget `scrub` admin verb. Waits without
+        cancelling; a failed PG's slot is None (the failure is already
+        crash-recorded by _bg_task_done)."""
+        tasks = self._spawn_scrubs(deep)
+        await drain_all(tasks.values())
+        out: dict[str, dict] = {}
+        for key, task in tasks.items():
+            out[key] = (task.result()
+                        if not task.cancelled()
+                        and task.exception() is None else None)
+        return out
+
+    def _list_inconsistent(self, pool=None) -> dict:
+        """Admin `list-inconsistent-obj`: the per-PG registries of every
+        primary PG, newest scrub knowledge (the `rados
+        list-inconsistent-obj` analog)."""
+        out: dict = {}
+        for pgid, pg in self.pgs.items():
+            if not pg.is_primary():
+                continue
+            if pool is not None and pgid.pool != int(pool):
+                continue
+            if pg.inconsistent_objects:
+                out[f"{pgid.pool}.{pgid.ps}"] = [
+                    dict(e) for _, e in
+                    sorted(pg.inconsistent_objects.items())]
+        return {"inconsistent": out,
+                "objects": sum(len(v) for v in out.values())}
+
+    def _scrub_health_metrics(self) -> dict:
+        """The scrub slice of the mgr health report: cluster health
+        checks (PG_DAMAGED / OSD_SCRUB_ERRORS) key off the registry
+        counts; the per-pool table feeds DaemonStateIndex
+        .scrub_aggregate() -> the ceph_scrub_*{pool=} exporter
+        families."""
+        inconsistent = unrepaired = damaged_pgs = 0
+        pools: dict[str, dict] = {}
+        now = time.time()
+        for pgid, pg in self.pgs.items():
+            if not pg.is_primary():
+                continue
+            name = getattr(pg.pool, "name", None) or str(pgid.pool)
+            p = pools.setdefault(name, {
+                "objects_scrubbed": 0, "bytes_hashed": 0,
+                "errors_found": 0, "errors_repaired": 0,
+                "inconsistent": 0, "unrepaired": 0,
+                "last_scrub_age_s": -1.0, "last_deep_scrub_age_s": -1.0})
+            st = pg.scrub_stats
+            p["objects_scrubbed"] += st["objects_scrubbed"]
+            p["bytes_hashed"] += st["bytes_hashed"]
+            p["errors_found"] += st["errors_found"]
+            p["errors_repaired"] += st["errors_repaired"]
+            reg = pg.inconsistent_objects
+            n_unrep = sum(1 for e in reg.values() if not e["repaired"])
+            p["inconsistent"] += len(reg)
+            p["unrepaired"] += n_unrep
+            inconsistent += len(reg)
+            unrepaired += n_unrep
+            if reg:
+                damaged_pgs += 1
+            for stamp, key in ((pg.last_scrub_stamp, "last_scrub_age_s"),
+                               (pg.last_deep_scrub_stamp,
+                                "last_deep_scrub_age_s")):
+                if stamp:
+                    age = round(now - stamp, 1)
+                    if p[key] < 0 or age > p[key]:
+                        p[key] = age
+        return {"inconsistent_objects": inconsistent,
+                "unrepaired_objects": unrepaired,
+                "inconsistent_pgs": damaged_pgs,
+                "pools": pools}
 
     def _bg_task_done(self, task: asyncio.Task) -> None:
         self._bg_tasks.discard(task)
@@ -787,15 +942,10 @@ class OSD(Dispatcher):
             last = time.monotonic()
             rounds += 1
             deep = rounds % self.config.get("osd_deep_scrub_every") == 0
-            for pg in list(self.pgs.values()):
-                if not (pg.is_primary() and pg.state == "active"):
-                    continue
-                try:
-                    await pg.scrub(deep=deep)
-                except Exception as e:
-                    dout("scrub", 1, f"pg {pg.pgid} scrub failed: "
-                                     f"{type(e).__name__} {e}")
-                    crash.record(f"osd.{self.whoami}", e)
+            # per-PG tasks with real handles: failures are crash-
+            # recorded by _bg_task_done, stragglers are reaped at
+            # stop() via _bg_tasks — nothing fire-and-forget
+            await self.scrub_all(deep=deep)
 
     async def _reboot_until_up(self) -> None:
         """Resend MOSDBoot until the map shows us up again (mirrors the

@@ -87,6 +87,17 @@ class PGInstance:
         self._scrub_waiters: dict[tuple, asyncio.Future] = {}
         self.last_scrub: dict | None = None
         self._scrub_lock = asyncio.Lock()
+        # scrub observability: live round progress, wall-clock stamps,
+        # cumulative counters, and the inconsistent-object registry
+        # (list-inconsistent-obj + the mgr PG_DAMAGED check source) —
+        # entries persist until a clean same-or-deeper round retires
+        # them, so health clears only on a verified-clean rescan
+        self.scrub_progress = None
+        self.last_scrub_stamp = 0.0
+        self.last_deep_scrub_stamp = 0.0
+        self.scrub_stats = {"objects_scrubbed": 0, "bytes_hashed": 0,
+                            "errors_found": 0, "errors_repaired": 0}
+        self.inconsistent_objects: dict[str, dict] = {}
         # write gate: scrub blocks new modifies and drains in-flight ones
         # so repairs never race an acknowledged write (the reference's
         # scrub-range write blocking)
@@ -816,9 +827,39 @@ class PGInstance:
                     self.host.store, self.backend.coll(), self._meta_gh(),
                     snapid)
                 for oid in names:
-                    await self._do_modify(
-                        "snaptrim", oid,
-                        {"oid": oid, "snapid": snapid}, b"")
+                    # each trim rides the op queue under the DECLARED
+                    # snaptrim background class (profile.py): dmclock
+                    # paces snap GC against client I/O, its reservation
+                    # keeps it moving. obj=oid serializes against
+                    # client ops touching the clone being trimmed; the
+                    # done-future carries the trim's exception out so
+                    # the retry-on-next-map-advance path still sees it
+                    done = asyncio.get_running_loop().create_future()
+
+                    async def work(oid=oid, snapid=snapid, done=done):
+                        try:
+                            await self._do_modify(
+                                "snaptrim", oid,
+                                {"oid": oid, "snapid": snapid}, b"")
+                        except BaseException as e:
+                            if not done.done():
+                                done.set_exception(e)
+                            if isinstance(e, asyncio.CancelledError):
+                                raise
+                        else:
+                            if not done.done():
+                                done.set_result(None)
+
+                    if self.host.op_queue.enqueue(
+                            (self.pgid.pool, self.pgid.ps), work,
+                            klass="snaptrim", obj=oid,
+                            nbytes=self.host.op_queue.sched
+                            .cost_per_io_bytes):
+                        await done
+                    else:
+                        await self._do_modify(
+                            "snaptrim", oid,
+                            {"oid": oid, "snapid": snapid}, b"")
                     await asyncio.sleep(0)     # yield between objects
                 self.purged_snaps.add(snapid)
                 self.persist_meta()
@@ -858,11 +899,19 @@ class PGInstance:
         return await scrub_pg(self, deep)
 
     async def handle_scrub_request(self, conn, msg) -> None:
+        # Replica side: scan exactly the name range the primary asked
+        # for, unpaced — the primary takes the QoS grant per range and
+        # holds the write gate while replies are outstanding, so local
+        # pacing here would only stretch the gated window.
         from ceph_tpu.osd.scrub import build_scrub_map
         p = msg.payload
+        rng = p.get("range")
         conn.send_message(MOSDRepScrubMap(
             {"pgid": p["pgid"], "tid": p["tid"], "from": self.host.whoami,
-             "map": await build_scrub_map(self, p.get("deep", False))}))
+             "map": await build_scrub_map(
+                 self, p.get("deep", False),
+                 oid_range=tuple(rng) if rng is not None else None,
+                 paced=False)}))
 
     def handle_scrub_map(self, msg) -> None:
         p = msg.payload

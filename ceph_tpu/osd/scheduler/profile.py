@@ -4,11 +4,10 @@ op_scheduler_class + mClock profile defaults).
 Classes are DECLARED here, not hardcoded in the queue: each ClassSpec
 carries both the legacy WRR weight (the scheduler-off arbitration) and
 the dmclock parameters its pseudo-entity runs with when the scheduler
-is on. Background classes (recovery today; deep-scrub's best-effort
-class lands here next) are queue-side entities — they arbitrate
-against client tenants under the same tag clocks, which is exactly how
-a reservation guarantees recovery progress without letting it starve
-clients.
+is on. Background classes (recovery, scrub, snaptrim) are queue-side
+entities — they arbitrate against client tenants under the same tag
+clocks, which is exactly how a reservation guarantees background
+progress without letting it starve clients.
 """
 from __future__ import annotations
 
@@ -76,14 +75,22 @@ class QosProfile:
 
 def default_profile() -> QosProfile:
     """The stock OSD profile: client traffic at the historical 4:1 WRR
-    edge over recovery; under dmclock, recovery's pseudo-entity gets a
-    small reservation (guaranteed progress while degraded) but only
-    half a client tenant's weight (yields excess bandwidth). The old
-    hardcoded `scrub` class had no producer and is gone — scrub work
-    registers its own class when it grows a queue-side producer."""
+    edge over the background classes; under dmclock, recovery's
+    pseudo-entity gets a small reservation (guaranteed progress while
+    degraded) but only half a client tenant's weight (yields excess
+    bandwidth). Scrub and snaptrim are DECLARED background customers —
+    scrub's scan-chunk grant tokens and snaptrim's per-object trims
+    enqueue under these specs, so they pace against client I/O with a
+    guaranteed trickle instead of late-registering at best-effort
+    wrr=1 defaults. Their reservations are deliberately small: integrity
+    scanning and snap GC must keep moving, never compete."""
     return QosProfile([
         ClassSpec("client", wrr=4,
                   reservation=0.0, limit=0.0, weight=1.0),
         ClassSpec("recovery", wrr=1, background=True,
                   reservation=4.0, limit=0.0, weight=0.5),
+        ClassSpec("scrub", wrr=1, background=True,
+                  reservation=2.0, limit=0.0, weight=0.25),
+        ClassSpec("snaptrim", wrr=1, background=True,
+                  reservation=1.0, limit=0.0, weight=0.25),
     ])

@@ -22,6 +22,26 @@ compare, ECBackend.cc:1092-1120 deep shard verify):
     converge on the majority fingerprint, pulled first if the primary
     itself is wrong.
 
+Observability (the continuous-integrity layer):
+
+  * deep-scrub content digests are BATCHED through the offload
+    service's CrcJob path (`OffloadService.crc32c_blocks`) — one
+    coalesced hash job per scan chunk instead of a per-chunk host loop,
+    bit-identical to the `ec_native.crc32c` host fallback because both
+    run the same slice-by-8 kernel with the same seed;
+  * scans are CHUNKED (`osd_scrub_chunk_max` objects per grant, an
+    optional `osd_scrub_sleep` pause between chunks) and each chunk
+    pre-pays a zero-work grant token through the op queue under the
+    declared background `scrub` class, so dmclock arbitration paces
+    scrub against client I/O while its reservation guarantees forward
+    progress;
+  * every round updates per-PG progress (`pg.scrub_progress`), stamps
+    (`last_scrub_stamp` / `last_deep_scrub_stamp`), cumulative
+    `pg.scrub_stats`, and the per-PG inconsistent-object registry
+    (`pg.inconsistent_objects`, the `list-inconsistent-obj` source);
+    mismatches/repairs/aborts drop flight-recorder crumbs and the
+    process-wide "scrub" perf logger rides the mgr report leg.
+
 Idiomatic divergences: one round-trip map exchange instead of chunked
 scrub reservations/ranges (PGs here are small); light scrub compares
 size+attrs digests, deep scrub re-reads and re-hashes everything — same
@@ -31,34 +51,206 @@ from __future__ import annotations
 
 import asyncio
 import json
+import threading
+import time
 from typing import TYPE_CHECKING
+
+import numpy as np
 
 from ceph_tpu.msg.messages import MOSDRepScrub, MOSDRepScrubMap
 from ceph_tpu.objectstore.store import StoreError
+from ceph_tpu.utils import flight
 from ceph_tpu.utils.dout import dout
+from ceph_tpu.utils.perf_counters import (TYPE_HISTOGRAM,
+                                          PerfCountersCollection)
 
 if TYPE_CHECKING:
     from ceph_tpu.osd.pg import PGInstance
 
 SCRUB_PEER_TIMEOUT = 10.0
+#: bound on one range's wait for its QoS grant. Grants are taken with
+#: the PG write gate OPEN (client writes flow while scrub waits its
+#: turn), so there is no gate/queue deadlock — the bound is pure
+#: robustness: the scheduler shapes scrub, it must never wedge it.
+#: On timeout the range proceeds ungranted (counted + crumbed).
+SCRUB_GRANT_TIMEOUT = 5.0
 _SCAN_YIELD_EVERY = 32      # objects hashed between event-loop yields
+_DIGEST_BLOCK = 4096        # replicated-pool digest batch block size
 
 # fingerprint sentinel: the object does not exist on that OSD. A real
 # value (not exclusion) so deletions can win the majority vote.
 ABSENT = "__absent__"
 
+_perf_lock = threading.Lock()
 
-async def build_scrub_map(pg: "PGInstance", deep: bool) -> dict:
+
+def scrub_perf():
+    """The process-wide "scrub" perf logger, created on first use.
+    Rides `perf dump` and the MgrClient report leg via extra_loggers
+    (exported with the `scrub_` prefix: `scrub_bytes_hashed`, ...)."""
+    coll = PerfCountersCollection.instance()
+    with _perf_lock:
+        pc = coll.get("scrub")
+        if pc is not None:
+            return pc
+        pc = coll.create("scrub")
+        pc.add("bytes_hashed",
+               description="content bytes digested by deep scrub")
+        pc.add("objects_hashed",
+               description="objects whose content digests were computed")
+        pc.add("rounds",
+               description="scrub rounds completed on this node's "
+                           "primary PGs")
+        pc.add("deep_rounds",
+               description="deep rounds among the completed rounds")
+        pc.add("chunks",
+               description="scan chunks processed (each chunk = one "
+                           "QoS grant under the scrub class)")
+        pc.add("errors_found",
+               description="inconsistent copies/shards detected by "
+                           "map compare")
+        pc.add("errors_repaired",
+               description="copies/shards repaired through the "
+                           "recovery machinery")
+        pc.add("errors_unrepaired",
+               description="objects left unrepaired (no majority to "
+                           "repair toward)")
+        pc.add("aborts",
+               description="scrub rounds that died on an exception or "
+                           "cancellation")
+        pc.add("grant_timeouts",
+               description="scan chunks that proceeded after their QoS "
+                           "grant timed out (forward-progress escape "
+                           "hatch)")
+        pc.add("digest_batch_blocks", type=TYPE_HISTOGRAM,
+               description="blocks per offloaded digest batch")
+        pc.add("digest_batch_us", type=TYPE_HISTOGRAM,
+               description="wall microseconds per digest batch")
+        return pc
+
+
+class ScrubProgress:
+    """Live progress of one scrub round, published at `pg.scrub_progress`
+    while the round runs (mgr progress events + admin `last_scrub`)."""
+
+    __slots__ = ("pgid", "deep", "state", "objects_total",
+                 "objects_scrubbed", "bytes_hashed", "started_mono")
+
+    def __init__(self, pgid, deep: bool):
+        self.pgid = str(pgid)
+        self.deep = deep
+        self.state = "scrubbing"
+        self.objects_total = 0
+        self.objects_scrubbed = 0
+        self.bytes_hashed = 0
+        self.started_mono = time.monotonic()
+
+    def finish(self, state: str = "done") -> None:
+        self.state = state
+
+    def to_dict(self) -> dict:
+        dt = max(1e-9, time.monotonic() - self.started_mono)
+        return {"pgid": self.pgid, "deep": self.deep, "state": self.state,
+                "objects_scrubbed": self.objects_scrubbed,
+                "objects_total": self.objects_total,
+                "bytes_hashed": self.bytes_hashed,
+                "bytes_per_s": round(self.bytes_hashed / dt, 1),
+                "elapsed_s": round(dt, 3)}
+
+
+def _cfg(pg: "PGInstance", name: str, default):
+    try:
+        v = pg.host.config.get(name)
+        return default if v is None else v
+    except Exception:
+        return default
+
+
+async def _qos_grant(pg: "PGInstance") -> None:
+    """Pre-pay one scan chunk through the op queue under the declared
+    background `scrub` class: the grant is a zero-work token billed at
+    one IO cost unit, so dmclock paces scrub against client load and
+    the class reservation guarantees it keeps moving. Bounded wait —
+    see SCRUB_GRANT_TIMEOUT."""
+    q = getattr(pg.host, "op_queue", None)
+    if q is None:
+        return
+    done = asyncio.get_running_loop().create_future()
+
+    async def work():
+        if not done.done():
+            done.set_result(None)
+
+    # distinct key: the grant must not ride (and stall behind) this
+    # PG's own client-write pipeline window
+    if not q.enqueue(("scrub", pg.pgid.pool, pg.pgid.ps), work,
+                     klass="scrub", nbytes=q.sched.cost_per_io_bytes):
+        return
+    try:
+        await asyncio.wait_for(done, SCRUB_GRANT_TIMEOUT)
+    except asyncio.TimeoutError:
+        scrub_perf().inc("grant_timeouts")
+        flight.record("scrub_grant_timeout", f"pg.{pg.pgid}",
+                      waited_s=SCRUB_GRANT_TIMEOUT)
+
+
+def _in_range(oid: str, oid_range) -> bool:
+    """Membership in a half-open name range `(lo, hi]` (None = open
+    end). Exclusive lo / inclusive hi so consecutive ranges sharing a
+    boundary partition the namespace with no gap and no overlap."""
+    lo, hi = oid_range
+    return (lo is None or oid > lo) and (hi is None or oid <= hi)
+
+
+async def build_scrub_map(pg: "PGInstance", deep: bool,
+                          progress: "ScrubProgress | None" = None,
+                          oid_range=None, paced: bool = True) -> dict:
     """Per-object scrub entries for the local store (the reference's
-    build_scrub_map_chunk / be_scan_list). Yields to the event loop
-    periodically: a large deep scan must not stall heartbeats."""
+    build_scrub_map_chunk / be_scan_list). With `oid_range=(lo, hi]`
+    only names inside the range are scanned — the primary drives the
+    round range-by-range and peers answer for exactly the requested
+    slice, so absence within a range map is authoritative. Chunked:
+    every `osd_scrub_chunk_max` objects cost one QoS grant when
+    `paced` (standalone/full builds; range scans are paced by the
+    primary at the range level and run here with paced=False), deep
+    content digests for a chunk are hashed as ONE offload batch, and
+    an optional `osd_scrub_sleep` pause between chunks yields the disk
+    to client I/O. Yields to the event loop periodically: a large deep
+    scan must not stall heartbeats."""
+    if pg.pool.type == "erasure" and (oid_range is None
+                                      or oid_range[0] is None):
+        # once per round, on the first range
+        _gc_rollback_generations(pg)
+    oids = sorted(pg.list_objects())
+    if oid_range is not None:
+        oids = [o for o in oids if _in_range(o, oid_range)]
+    elif progress is not None:
+        progress.objects_total = len(oids)
+    chunk_max = max(1, int(_cfg(pg, "osd_scrub_chunk_max", 32)))
+    sleep_s = float(_cfg(pg, "osd_scrub_sleep", 0.0))
+    out: dict[str, dict] = {}
+    for start in range(0, len(oids), chunk_max):
+        chunk = oids[start:start + chunk_max]
+        if paced:
+            await _qos_grant(pg)
+        await _scan_chunk(pg, chunk, deep, out, progress)
+        scrub_perf().inc("chunks")
+        if progress is not None:
+            progress.objects_scrubbed += len(chunk)
+        if paced and sleep_s > 0 and start + chunk_max < len(oids):
+            await asyncio.sleep(sleep_s)
+    return out
+
+
+async def _scan_chunk(pg: "PGInstance", oids: list, deep: bool,
+                      out: dict, progress: "ScrubProgress | None") -> None:
+    """Scan one chunk of objects: metadata host-side, deep content
+    digests deferred into one `_digest_batch` offload job."""
     from ceph_tpu.native import ec_native
     store = pg.host.store
     cid = pg.backend.coll()
-    if pg.pool.type == "erasure":
-        _gc_rollback_generations(pg)
-    out: dict[str, dict] = {}
-    for i, oid in enumerate(pg.list_objects()):
+    pend: list = []         # (oid, ent, data, csum-or-None)
+    for i, oid in enumerate(oids):
         if i % _SCAN_YIELD_EVERY == _SCAN_YIELD_EVERY - 1:
             await asyncio.sleep(0)
         gh = pg.backend.ghobject(oid)
@@ -78,27 +270,97 @@ async def build_scrub_map(pg: "PGInstance", deep: bool) -> dict:
                 if deep:
                     data = store.read(cid, gh)
                     c = pg.backend.sinfo.chunk_size
-                    for s in range(len(csum)):
-                        have = ec_native.crc32c(data[s * c:(s + 1) * c])
-                        if have != csum[s]:
-                            ent["corrupt"] = True
-                            break
                     if len(data) != len(csum) * c:
                         ent["corrupt"] = True
+                    else:
+                        pend.append((oid, ent, data, csum))
             elif deep:
                 data = store.read(cid, gh)
-                ent["digest"] = ec_native.crc32c(data)
                 omap = store.omap_get(cid, gh)
                 ent["omap_digest"] = ec_native.crc32c(
                     b"\x00".join(k.encode() + b"=" + v
                                  for k, v in sorted(omap.items())))
+                pend.append((oid, ent, data, None))
         except StoreError as e:
             # a FileStore blob whose crc gate refuses the read is a
             # corrupt local copy — exactly what scrub exists to find
             dout("scrub", 1, f"scrub read {oid}: {e}")
             ent["corrupt"] = True
         out[oid] = ent
-    return out
+    if pend:
+        await _digest_batch(pg, pend, progress)
+
+
+async def _digest_batch(pg: "PGInstance", pend: list,
+                        progress: "ScrubProgress | None") -> None:
+    """Hash one chunk's content as a single crc32c block batch through
+    the offload service (host fallback: the same `ec_native`
+    slice-by-8 kernel — bit-identical either way). EC shards check the
+    per-block crcs against the stored csum vector; replicated copies
+    fold the block crcs into one whole-object digest."""
+    from ceph_tpu.native import ec_native
+    from ceph_tpu.offload.service import get_service_or_none
+    perf = scrub_perf()
+    t0 = time.perf_counter()
+    ec = pg.pool.type == "erasure"
+    block = pg.backend.sinfo.chunk_size if ec else _DIGEST_BLOCK
+    batch: list[np.ndarray] = []
+    counts: list[int] = []
+    total_bytes = 0
+    for oid, ent, data, csum in pend:
+        n, tail = divmod(len(data), block)
+        if tail:
+            n += 1
+            buf = np.zeros(n * block, dtype=np.uint8)
+            buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+        else:
+            buf = np.frombuffer(data, dtype=np.uint8)
+        if n:
+            batch.append(buf.reshape(n, block))
+        counts.append(n)
+        total_bytes += len(data)
+    nblocks = sum(counts)
+    if nblocks:
+        svc = get_service_or_none()
+        if svc is not None:
+            crcs = await svc.crc32c_blocks(batch, block)
+        else:
+            flat = np.concatenate([b.reshape(-1) for b in batch])
+            crcs = ec_native.crc32c_blocks(flat, block)
+        crcs = np.asarray(crcs, dtype=np.uint32)
+    else:
+        crcs = np.zeros(0, dtype=np.uint32)
+    pos = 0
+    for (oid, ent, data, csum), n in zip(pend, counts):
+        mine = crcs[pos:pos + n]
+        pos += n
+        if ec:
+            # the length check already ran; every stored csum entry has
+            # a freshly hashed counterpart
+            for s in range(len(csum)):
+                if int(mine[s]) != int(csum[s]):
+                    ent["corrupt"] = True
+                    break
+        else:
+            ent["digest"] = _fold_digest(mine, len(data))
+    perf.inc("bytes_hashed", total_bytes)
+    perf.inc("objects_hashed", len(pend))
+    perf.hist_add("digest_batch_blocks", nblocks)
+    perf.hist_add("digest_batch_us",
+                  (time.perf_counter() - t0) * 1e6)
+    if progress is not None:
+        progress.bytes_hashed += total_bytes
+
+
+def _fold_digest(crcs: np.ndarray, total_len: int) -> int:
+    """Whole-object digest from per-block crcs + true length (the tail
+    block is zero-padded, so the length disambiguates). Deterministic
+    pure function of (content, length): every OSD recomputes it per
+    round, nothing is stored, so all replicas agree by construction."""
+    from ceph_tpu.native import ec_native
+    return ec_native.crc32c(
+        np.asarray(crcs, dtype="<u4").tobytes()
+        + int(total_len).to_bytes(8, "little"))
 
 
 def _gc_rollback_generations(pg: "PGInstance") -> None:
@@ -119,21 +381,84 @@ def _gc_rollback_generations(pg: "PGInstance") -> None:
             store.queue_transaction(Transaction().remove(cid, gh))
 
 
+def _note_inconsistent(pg: "PGInstance", oid: str, bad_osds: list,
+                       kind: str, deep: bool) -> None:
+    """Register a detected inconsistency (the `list-inconsistent-obj`
+    registry) and drop the flight crumb. Entries persist until a clean
+    same-or-deeper round retires them, so PG_DAMAGED raises at
+    detection and clears only on a verified-clean rescan."""
+    flight.record("scrub_mismatch", f"pg.{pg.pgid}", oid=oid,
+                  osds=list(bad_osds), kind=kind, deep=deep)
+    pg.inconsistent_objects[oid] = {
+        "oid": oid, "osds": sorted(bad_osds), "kind": kind,
+        "deep": deep, "repaired": False,
+        "pending": sorted(bad_osds), "stamp": time.time()}
+
+
+def _note_repaired(pg: "PGInstance", oid: str, osd: int, ok: bool,
+                   kind: str) -> None:
+    flight.record("scrub_repair", f"pg.{pg.pgid}", oid=oid, osd=osd,
+                  ok=ok, kind=kind)
+    entry = pg.inconsistent_objects.get(oid)
+    if entry is None or not ok:
+        return
+    entry["pending"] = [o for o in entry["pending"] if o != osd]
+    if not entry["pending"]:
+        entry["repaired"] = True
+
+
 async def scrub_pg(pg: "PGInstance", deep: bool) -> dict:
-    """Primary-side scrub round: block writes, gather maps, compare,
-    repair, unblock."""
+    """Primary-side scrub round, range-gated like the reference's
+    chunky scrub: the namespace is walked in sorted-name ranges and
+    client writes are blocked only while ONE range is being scanned,
+    compared and repaired on all OSDs — between ranges the gate is
+    open, so a colliding write waits out a small chunk, not the whole
+    round. Publishes live progress at `pg.scrub_progress` and crumbs
+    aborted rounds."""
     async with pg._scrub_lock:           # one scrub per PG at a time
-        await pg.block_writes()
+        progress = ScrubProgress(pg.pgid, deep)
+        pg.scrub_progress = progress
         try:
-            return await _scrub_locked(pg, deep)
+            return await _scrub_locked(pg, deep, progress)
+        except BaseException as e:
+            progress.finish("aborted")
+            scrub_perf().inc("aborts")
+            flight.record("scrub_abort", f"pg.{pg.pgid}", deep=deep,
+                          reason=f"{type(e).__name__}: {e}")
+            raise
         finally:
-            pg.unblock_writes()
+            if progress.state == "scrubbing":
+                progress.finish()
 
 
-async def _scrub_locked(pg: "PGInstance", deep: bool) -> dict:
+def _plan_ranges(oids: list, chunk_max: int) -> list:
+    """Partition the whole name space into `(lo, hi]` ranges with a
+    boundary every `chunk_max` names of the primary's sorted listing.
+    First range starts at None and last ends at None: peer-only names
+    (strays the primary never listed) sort into SOME range and are
+    still compared, which is what majority-delete detection needs."""
+    bounds = [oids[i] for i in range(chunk_max - 1, len(oids), chunk_max)]
+    if bounds and bounds[-1] == oids[-1]:
+        bounds.pop()                     # tail range is open-ended anyway
+    ranges, lo = [], None
+    for b in bounds:
+        ranges.append((lo, b))
+        lo = b
+    ranges.append((lo, None))
+    return ranges
+
+
+async def _scrub_range(pg: "PGInstance", deep: bool, oid_range,
+                       progress: "ScrubProgress") -> dict:
+    """Gather this range's maps from self + up acting peers and
+    compare/repair it. Caller holds the write gate, so the slice is
+    frozen across all OSDs while it is judged."""
     host = pg.host
+    maps: dict[int, dict] = {
+        host.whoami: await build_scrub_map(pg, deep, progress,
+                                           oid_range=oid_range,
+                                           paced=False)}
     tid = pg.backend.new_tid()
-    maps: dict[int, dict] = {host.whoami: await build_scrub_map(pg, deep)}
     waits = []
     for peer in sorted(pg.acting_peers()):
         if not host.osdmap.is_up(peer):
@@ -143,7 +468,8 @@ async def _scrub_locked(pg: "PGInstance", deep: bool) -> dict:
         try:
             await host.send_osd(peer, MOSDRepScrub(
                 {"pgid": [pg.pgid.pool, pg.pgid.ps], "tid": tid,
-                 "from": host.whoami, "deep": deep}))
+                 "from": host.whoami, "deep": deep,
+                 "range": list(oid_range)}))
             waits.append((peer, fut))
         except Exception as e:
             dout("scrub", 2, f"scrub request to osd.{peer} failed: {e}")
@@ -154,19 +480,91 @@ async def _scrub_locked(pg: "PGInstance", deep: bool) -> dict:
             maps[peer] = await asyncio.wait_for(fut, SCRUB_PEER_TIMEOUT)
         except asyncio.TimeoutError:
             dout("scrub", 2, f"osd.{peer} never sent a scrub map")
+            flight.record("scrub_abort", f"pg.{pg.pgid}", deep=deep,
+                          reason="peer_timeout", peer=peer)
         finally:
             pg._scrub_waiters.pop((tid, peer), None)
 
     if pg.pool.type == "erasure":
-        result = await _compare_repair_ec(pg, maps, deep)
+        res = await _compare_repair_ec(pg, maps, deep)
     else:
-        result = await _compare_repair_replicated(pg, maps, deep)
+        res = await _compare_repair_replicated(pg, maps, deep)
+    res["osds"] = sorted(maps)
+    return res
+
+
+async def _scrub_locked(pg: "PGInstance", deep: bool,
+                        progress: "ScrubProgress") -> dict:
+    host = pg.host
+    t0 = time.monotonic()
+    oids = sorted(pg.list_objects())
+    progress.objects_total = len(oids)
+    chunk_max = max(1, int(_cfg(pg, "osd_scrub_chunk_max", 32)))
+    sleep_s = float(_cfg(pg, "osd_scrub_sleep", 0.0))
+    ranges = _plan_ranges(oids, chunk_max)
+
+    result: dict = {"errors": 0, "repaired": 0,
+                    "inconsistent": [], "unrepaired": []}
+    seen_osds = {host.whoami}
+    for i, rng in enumerate(ranges):
+        # pace UNGATED: while scrub waits for its dmclock turn (and
+        # between ranges) client writes flow freely — this is where
+        # the QoS class actually shapes scrub against foreground load
+        await _qos_grant(pg)
+        await pg.block_writes()
+        try:
+            r = await _scrub_range(pg, deep, rng, progress)
+        finally:
+            pg.unblock_writes()
+        result["errors"] += r["errors"]
+        result["repaired"] += r["repaired"]
+        result["inconsistent"].extend(r["inconsistent"])
+        result["unrepaired"].extend(r.get("unrepaired", []))
+        seen_osds.update(r["osds"])
+        if sleep_s > 0 and i + 1 < len(ranges):
+            await asyncio.sleep(sleep_s)
+
     result["deep"] = deep
-    result["osds"] = sorted(maps)
+    result["osds"] = sorted(seen_osds)
+    result["objects"] = progress.objects_total
+    result["bytes_hashed"] = progress.bytes_hashed
+    dt = max(1e-9, time.monotonic() - t0)
+    result["duration_s"] = round(dt, 3)
+    result["mb_s"] = round(progress.bytes_hashed / dt / 2**20, 2)
     pg.last_scrub = result
+    now = time.time()
+    pg.last_scrub_stamp = now
+    if deep:
+        pg.last_deep_scrub_stamp = now
+
+    # a clean same-or-deeper round retires registry entries: the
+    # damage is VERIFIED gone, so the mgr health checks can clear
+    found = set(result["inconsistent"])
+    for oid in list(pg.inconsistent_objects):
+        entry = pg.inconsistent_objects[oid]
+        if oid not in found and (deep or not entry.get("deep")):
+            del pg.inconsistent_objects[oid]
+
+    perf = scrub_perf()
+    perf.inc("rounds")
+    if deep:
+        perf.inc("deep_rounds")
+    if result["errors"]:
+        perf.inc("errors_found", result["errors"])
+    if result["repaired"]:
+        perf.inc("errors_repaired", result["repaired"])
+    if result.get("unrepaired"):
+        perf.inc("errors_unrepaired", len(result["unrepaired"]))
+    st = pg.scrub_stats
+    st["objects_scrubbed"] += progress.objects_total
+    st["bytes_hashed"] += progress.bytes_hashed
+    st["errors_found"] += result["errors"]
+    st["errors_repaired"] += result["repaired"]
+
     dout("scrub", 2 if result["errors"] else 4,
          f"pg {pg.pgid} {'deep-' if deep else ''}scrub: "
-         f"{result['errors']} errors, {result['repaired']} repaired")
+         f"{result['errors']} errors, {result['repaired']} repaired, "
+         f"{result['objects']} objects, {result['mb_s']} MB/s hashed")
     return result
 
 
@@ -188,6 +586,7 @@ async def _compare_repair_ec(pg: "PGInstance", maps: dict,
             # majority says the object is gone: finish the deletion
             errors += len(holders)
             inconsistent.append(oid)
+            _note_inconsistent(pg, oid, holders, "stray", deep)
             for osd in holders:
                 try:
                     if osd == me:
@@ -196,7 +595,9 @@ async def _compare_repair_ec(pg: "PGInstance", maps: dict,
                         await pg.send_push(osd, oid, b"", None,
                                            delete=True)
                     repaired += 1
+                    _note_repaired(pg, oid, osd, True, "stray")
                 except Exception as e:
+                    _note_repaired(pg, oid, osd, False, "stray")
                     dout("scrub", 1, f"stray delete of {oid} on "
                                      f"osd.{osd} failed: {e}")
             continue
@@ -213,6 +614,7 @@ async def _compare_repair_ec(pg: "PGInstance", maps: dict,
             continue
         errors += len(bad)
         inconsistent.append(oid)
+        _note_inconsistent(pg, oid, bad, "shard", deep)
         for osd in bad:
             try:
                 if osd == me:
@@ -220,7 +622,9 @@ async def _compare_repair_ec(pg: "PGInstance", maps: dict,
                 else:
                     await pg.backend.push_object(osd, oid)
                 repaired += 1
+                _note_repaired(pg, oid, osd, True, "shard")
             except Exception as e:
+                _note_repaired(pg, oid, osd, False, "shard")
                 dout("scrub", 1, f"repair of {oid} shard on osd.{osd} "
                                  f"failed: {type(e).__name__} {e}")
     return {"errors": errors, "repaired": repaired,
@@ -261,6 +665,7 @@ async def _compare_repair_replicated(pg: "PGInstance", maps: dict,
         if not tally:
             unrepaired.append(oid)      # unreadable everywhere
             errors += len(prints)
+            _note_inconsistent(pg, oid, list(prints), "unreadable", deep)
             continue
         auth_fp, auth_osds = max(tally.items(), key=lambda kv: len(kv[1]))
         majority = len(auth_osds) > len(prints) / 2
@@ -269,6 +674,7 @@ async def _compare_repair_replicated(pg: "PGInstance", maps: dict,
             continue
         errors += len(bad)
         inconsistent.append(oid)
+        _note_inconsistent(pg, oid, bad, "copy", deep)
         if not majority and not (len(tally) == 1 and bad_by_corruption):
             # a corrupt copy may be repaired toward the only candidate
             # even without strict majority; a tie between two VALID
@@ -292,16 +698,19 @@ async def _compare_repair_replicated(pg: "PGInstance", maps: dict,
                                            delete=True,
                                            snap_state=snap_state)
                     repaired += 1
+                    _note_repaired(pg, oid, osd, True, "copy")
                 continue
             if me in bad:
                 # the primary's own copy is wrong: adopt an authoritative
                 # peer's before pushing
                 await pg.pull_transport(auth_osds[0], oid)
                 repaired += 1
+                _note_repaired(pg, oid, me, True, "copy")
                 bad.remove(me)
             for osd in bad:
                 await pg.backend.push_object(osd, oid)
                 repaired += 1
+                _note_repaired(pg, oid, osd, True, "copy")
         except Exception as e:
             dout("scrub", 1, f"repair of {oid} failed: "
                              f"{type(e).__name__} {e}")

@@ -1922,6 +1922,300 @@ def stage_qos_storm() -> dict:
     return results
 
 
+def stage_scrub_storm() -> dict:
+    """Continuous integrity verification graded as a background
+    workload on an 11-OSD CLAY(k=8,m=3,d=10, scalar_mds=tpu) pool:
+
+      1. hash-path calibration: one clean deep-scrub round with host
+         crc, one with the device CrcJob path (`ec_offload_crc_device`
+         hot-flipped) — `scrub_mb_s` is the device round, the ratio is
+         the device-vs-host grade, and the offload batch counters
+         prove the digests really rode the CrcJob path;
+      2. interference A/B: a paced swarm fleet measured with scrub
+         OFF, then the same fleet with continuous deep-scrub rounds
+         churning underneath — bit-rot injected on 12 objects via the
+         faultinject hook right before the ON window, so detection
+         latency (first scrub_mismatch flight crumb) and repair
+         correctness (CLAY single-shard rebuild + read-back) are
+         measured UNDER client load, and the victim p99 ratio is the
+         interference grade (bar: <= 1.25x);
+      3. health round-trip: fresh rot -> one deep round raises
+         PG_DAMAGED + OSD_SCRUB_ERRORS through the report leg (and the
+         exporter serves ceph_scrub_* families) -> a clean round
+         retires the registry -> both checks clear."""
+    import asyncio
+    import re as _re
+
+    t0 = time.perf_counter()
+    results: dict = {}
+    N_OSDS, K8, M3, D10 = 11, 8, 3, 10
+    N_ROT, N_ROT2 = 12, 3
+    N_CLIENTS, N_PROCS, SECONDS = 200, 2, 6.0
+
+    async def _http_get(addr, path: str) -> str:
+        reader, writer = await asyncio.open_connection(*addr)
+        writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+        await writer.drain()
+        blob = await reader.read()
+        writer.close()
+        return blob.split(b"\r\n\r\n", 1)[1].decode()
+
+    async def body():
+        import tempfile
+
+        from ceph_tpu.offload import service as offload
+        from ceph_tpu.osd import scrub as scrub_mod
+        from ceph_tpu.tools.rados_swarm import raise_fd_limit, run_swarm
+        from ceph_tpu.tools.vstart import VCluster
+        from ceph_tpu.utils import flight
+
+        raise_fd_limit(16384)
+        storm_kw = dict(
+            clients=N_CLIENTS, seconds=SECONDS, objects=96,
+            slow_readers=0, bullies=0, streamers=0, spammers=0,
+            victims=48, victim_iops=0.5, normal_iops=0.1,
+            adversary_depth=1, procs=N_PROCS, connect_batch=16,
+            op_timeout=60.0, settle_s=2.0)
+        with tempfile.TemporaryDirectory(prefix="bench-scrub-") as base:
+            c = VCluster(base, n_mons=1, n_osds=N_OSDS, with_mgr=True)
+            try:
+                await c.start()
+                cl = await c.client()
+                cl.OP_TIMEOUT = 60.0
+                await cl.command({
+                    "prefix": "osd erasure-code-profile set",
+                    "name": "scrubprof",
+                    "profile": {"plugin": "clay", "k": str(K8),
+                                "m": str(M3), "d": str(D10),
+                                "scalar_mds": "tpu"}})
+                await cl.pool_create("swarm", pg_num=8,
+                                     pool_type="erasure",
+                                     erasure_code_profile="scrubprof")
+                io = cl.ioctx("swarm")
+                # the periodic scheduler must not fire mid-grade: every
+                # round below is triggered explicitly. Small ranges +
+                # an inter-range breather keep each write-gate hold
+                # short — the gate covers one 4-object slice, and the
+                # sleep (taken with the gate OPEN) lets queued client
+                # writes drain between slices.
+                for osd in c.osds.values():
+                    osd.config.set("osd_scrub_interval", 100000.0)
+                    osd.config.set("osd_scrub_chunk_max", 4)
+                    osd.config.set("osd_scrub_sleep", 0.02)
+                pool = cl.osdmap.get_pool("swarm")
+                obj_bytes = 2 * pool.stripe_width
+                payloads = {f"rot-{i:03d}": os.urandom(obj_bytes)
+                            for i in range(128)}
+                names = sorted(payloads)
+                for i in range(0, len(names), 32):
+                    await asyncio.gather(*[
+                        io.write_full(n, payloads[n])
+                        for n in names[i:i + 32]])
+                results["scrub_storm_osds"] = N_OSDS
+                results["scrub_storm_object_bytes"] = obj_bytes
+
+                async def deep_round():
+                    """One explicit deep round over every primary PG,
+                    OSD by OSD (gates stagger instead of slamming every
+                    PG at once); returns the cross-PG aggregate."""
+                    rt = time.perf_counter()
+                    agg = {"errors": 0, "repaired": 0, "bytes": 0,
+                           "objects": 0}
+                    for osd in c.osds.values():
+                        for res in (await osd.scrub_all(
+                                deep=True)).values():
+                            if res:
+                                agg["errors"] += res["errors"]
+                                agg["repaired"] += res["repaired"]
+                                agg["bytes"] += res["bytes_hashed"]
+                                agg["objects"] += res["objects"]
+                    agg["dt"] = time.perf_counter() - rt
+                    return agg
+
+                # -- phase 1: host vs device hashing calibration ------
+                host = await deep_round()
+                assert host["errors"] == 0, host
+                host_mb_s = host["bytes"] / max(host["dt"], 1e-9) / 2**20
+                results["scrub_hash_host_mb_s"] = round(host_mb_s, 2)
+                # the guarded throughput number is the SHIPPING path —
+                # host-native CrcJob batches through the offload
+                # service (crc_device stays a measured experiment)
+                results["scrub_mb_s"] = round(host_mb_s, 2)
+                svc = offload.get_service()
+                c.osds[0].config.set("ec_offload_crc_device", True)
+                sperf = scrub_mod.scrub_perf()
+                b_before = svc.stats["batches"]
+                h_before = sperf.dump()["digest_batch_blocks"]["count"]
+                dev = await deep_round()
+                assert dev["errors"] == 0, dev
+                dev_mb_s = dev["bytes"] / max(dev["dt"], 1e-9) / 2**20
+                results["scrub_hash_device_mb_s"] = round(dev_mb_s, 2)
+                results["scrub_device_vs_host_hash_ratio"] = round(
+                    dev_mb_s / max(host_mb_s, 1e-9), 3)
+                results["scrub_offload_batches"] = \
+                    svc.stats["batches"] - b_before
+                results["scrub_digest_batches"] = \
+                    sperf.dump()["digest_batch_blocks"]["count"] \
+                    - h_before
+                # back to the host-native CrcJob dispatch for the
+                # loaded phases: on this container's narrow H2D link
+                # the device kernel is a measured loss (the ratio
+                # above), and a slow hash stretches every write-gate
+                # hold the interference grade is about to measure
+                c.osds[0].config.set("ec_offload_crc_device", False)
+                log(f"scrub hash: host {results['scrub_hash_host_mb_s']}"
+                    f" MB/s, device {results['scrub_hash_device_mb_s']}"
+                    f" MB/s over {results['scrub_offload_batches']} "
+                    f"offload batches")
+
+                # -- phase 2a: swarm baseline, scrub OFF --------------
+                off = await run_swarm(c.mon_addrs, "swarm",
+                                      client_prefix="so", **storm_kw)
+                p99_off = off["victim_p99_ms"]
+                results["scrub_client_p99_off_ms"] = p99_off
+                results["scrub_baseline_errors"] = off["errors"]
+                log(f"scrub OFF baseline: victim p99 {p99_off}ms "
+                    f"errors={off['errors']}")
+
+                # -- phase 2b: bit-rot + swarm with scrub churning ----
+                rot = names[:N_ROT]
+                osd_ids = sorted(c.osds)
+                for i, oid in enumerate(rot):
+                    r = await c.osds[osd_ids[i % N_OSDS]] \
+                        ._inject_bitrot(oid)
+                    assert r.get("injected") == "bitrot", r
+                t_inject = time.monotonic()
+                results["scrub_bitrot_injected"] = len(rot)
+
+                churn = {"errors": 0, "repaired": 0, "bytes": 0,
+                         "rounds": 0, "busy_s": 0.0}
+                stop = asyncio.Event()
+                prim_pgs = [pg for osd in c.osds.values()
+                            for pg in osd.pgs.values()
+                            if pg.is_primary() and pg.state == "active"]
+
+                async def scrub_churn():
+                    """Continuous verification shaped for live
+                    clusters: ONE PG's deep round at a time with a
+                    breather between — the whole-round write gate only
+                    ever covers one PG, so a colliding client write
+                    waits one short round, not a full sweep."""
+                    i = 0
+                    while not stop.is_set():
+                        pg = prim_pgs[i % len(prim_pgs)]
+                        i += 1
+                        rt = time.perf_counter()
+                        res = await pg.scrub(deep=True)
+                        churn["busy_s"] += time.perf_counter() - rt
+                        churn["errors"] += res["errors"]
+                        churn["repaired"] += res["repaired"]
+                        churn["bytes"] += res["bytes_hashed"]
+                        churn["rounds"] += 1
+                        try:
+                            await asyncio.wait_for(stop.wait(), 0.25)
+                        except asyncio.TimeoutError:
+                            pass
+
+                churn_task = asyncio.get_running_loop().create_task(
+                    scrub_churn())
+                try:
+                    on = await run_swarm(c.mon_addrs, "swarm",
+                                         client_prefix="sn", **storm_kw)
+                finally:
+                    stop.set()
+                    await churn_task
+                # sweep the stragglers: PGs whose turn never came in
+                # the loaded window still owe their detection + repair
+                sweep = await deep_round()
+                churn["errors"] += sweep["errors"]
+                churn["repaired"] += sweep["repaired"]
+                p99_on = on["victim_p99_ms"]
+                results["scrub_client_p99_on_ms"] = p99_on
+                results["scrub_storm_client_errors"] = on["errors"]
+                results["scrub_rounds_under_load"] = churn["rounds"]
+                results["scrub_errors_found"] = churn["errors"]
+                results["scrub_errors_repaired"] = churn["repaired"]
+                results["scrub_under_load_mb_s"] = round(
+                    churn["bytes"] / max(churn["busy_s"], 1e-9) / 2**20,
+                    2)
+                results["scrub_client_p99_interference_pct"] = round(
+                    100.0 * p99_on / max(p99_off, 1e-9), 1)
+                results["scrub_interference_ok"] = bool(
+                    p99_on <= 1.25 * p99_off)
+                mism = [e for e in
+                        flight.dump(etype="scrub_mismatch")["events"]
+                        if e["detail"].get("oid", "").startswith("rot-")]
+                if mism:
+                    results["scrub_detect_latency_s"] = round(
+                        min(e["mono"] for e in mism) - t_inject, 3)
+                results["scrub_repair_crumbs"] = len(
+                    flight.dump(etype="scrub_repair")["events"])
+                bad = 0
+                for oid in rot:
+                    if await io.read(oid) != payloads[oid]:
+                        bad += 1
+                results["scrub_repair_readback_bad"] = bad
+                log(f"scrub ON: {churn['rounds']} rounds under load, "
+                    f"{churn['errors']} found {churn['repaired']} "
+                    f"repaired, detect "
+                    f"{results.get('scrub_detect_latency_s')}s, victim "
+                    f"p99 {p99_on}ms vs {p99_off}ms off "
+                    f"({results['scrub_client_p99_interference_pct']}%)"
+                    f" readback_bad={bad}")
+
+                # -- phase 3: health raise -> exporter -> clear -------
+                rot2 = names[N_ROT:N_ROT + N_ROT2]
+                for i, oid in enumerate(rot2):
+                    r = await c.osds[osd_ids[(i + 5) % N_OSDS]] \
+                        ._inject_bitrot(oid)
+                    assert r.get("injected") == "bitrot", r
+                hr = await deep_round()
+                assert hr["errors"] >= len(rot2), hr
+                registry = sum(
+                    o._list_inconsistent(None)["objects"]
+                    for o in c.osds.values())
+                results["scrub_registry_objects"] = registry
+
+                async def health_has(*codes):
+                    h = await cl.command({"prefix": "health detail"})
+                    return all(code in h["checks"] for code in codes)
+
+                deadline = asyncio.get_running_loop().time() + 30
+                raised = False
+                while asyncio.get_running_loop().time() < deadline:
+                    if await health_has("PG_DAMAGED",
+                                        "OSD_SCRUB_ERRORS"):
+                        raised = True
+                        break
+                    await asyncio.sleep(0.5)
+                results["scrub_health_raised"] = raised
+                text = await _http_get(c.mgr.exporter.addr, "/metrics")
+                fams = sorted(set(_re.findall(
+                    r"# TYPE (ceph_scrub_[a-z0-9_]+)", text)))
+                results["scrub_exporter_families"] = len(fams)
+                clean = await deep_round()
+                assert clean["errors"] == 0, clean
+                deadline = asyncio.get_running_loop().time() + 30
+                cleared = False
+                while asyncio.get_running_loop().time() < deadline:
+                    if not await health_has("PG_DAMAGED") \
+                            and not await health_has(
+                                "OSD_SCRUB_ERRORS"):
+                        cleared = True
+                        break
+                    await asyncio.sleep(0.5)
+                results["scrub_health_cleared"] = cleared
+                log(f"scrub health: raised={raised} cleared={cleared} "
+                    f"{len(fams)} ceph_scrub_* exporter families, "
+                    f"registry held {registry} objects")
+            finally:
+                await c.stop()
+
+    asyncio.run(asyncio.wait_for(body(), 520))
+    results["elapsed_s"] = round(time.perf_counter() - t0, 1)
+    return results
+
+
 # -- attribution: the "where the 450x goes" waterfall -------------------------
 
 #: waterfall buckets in pipeline order; "other" is the residual the
@@ -2378,6 +2672,10 @@ TREND_KEYS = ("tpu_encode", "tpu_decode", "failure_storm_recovery_mb_s",
               # QoS arbiter ON: a drop means isolation got leakier or
               # the arbiter started taxing the good citizens
               "qos_goodput_mb_s",
+              # deep-scrub hashing throughput through the device CrcJob
+              # path (the clean calibration round): a drop means the
+              # digest batching or the offload crc leg got slower
+              "scrub_mb_s",
               "offload_mean_batch_ops",
               # the r04->r05 35.2->32.0 GB/s slide, re-baselined as a
               # fraction of the measured device peak: normalizing by
@@ -2400,6 +2698,11 @@ TREND_KEYS_COST = ("copy_amplification", "loop_busy_fraction",
                    # fairness spread widening or the paced victim
                    # band's p99 creeping up IS the QoS regression
                    "qos_fairness_ratio", "qos_victim_p99_ms",
+                   # scrub-ON victim p99 as % of the scrub-OFF
+                   # baseline under the same swarm load: creeping up
+                   # means background verification started taxing
+                   # foreground clients
+                   "scrub_client_p99_interference_pct",
                    "msgr_frames_per_ec_write",
                    "pg_pipeline_stall_fraction",
                    "interleave_sanitizer_overhead_pct",
@@ -2490,6 +2793,7 @@ def main() -> int:
                                        "cluster", "cluster_tpu",
                                        "attribution", "failure_storm",
                                        "swarm", "qos_storm",
+                                       "scrub_storm",
                                        "mesh_scaling",
                                        "interleave"],
                    required=True)
@@ -2501,6 +2805,7 @@ def main() -> int:
            "failure_storm": stage_failure_storm,
            "swarm": stage_swarm,
            "qos_storm": stage_qos_storm,
+           "scrub_storm": stage_scrub_storm,
            "mesh_scaling": stage_mesh_scaling,
            "interleave": stage_interleave}[args.stage]()
     print(json.dumps(out), flush=True)

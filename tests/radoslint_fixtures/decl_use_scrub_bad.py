@@ -1,0 +1,16 @@
+"""Known-positive decl-use: the scrub observability surface rotted —
+an `osd_scrub_*` pacing knob no scan loop reads (an operator throttling
+scrub changes nothing) and a scrub perf counter that would graph
+forever-zero on the dashboard."""
+
+
+class PerfCounters:        # base stub: the lint keys on the base NAME
+    pass
+
+
+class GhostScrubCounters(PerfCounters):
+    def __init__(self, config, Option):
+        config.declare(Option("osd_scrub_ghost_sleep", "float", 0.0,
+                              "an inter-chunk throttle nobody consults"))
+        self.add("scrub_ghost_bytes",
+                 description="hashed-bytes counter never incremented")

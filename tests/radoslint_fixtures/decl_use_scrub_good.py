@@ -1,0 +1,39 @@
+"""Known-negative decl-use: the scrub observability surface declared
+the way osd/daemon.py + osd/scrub.py really declare it — the chunk
+pacing knobs read by the scan loop, the mclock scrub knobs re-armed
+hot through an observer, and the scrub perf counters declared on the
+process-wide logger and fed on the hash/abort paths."""
+
+
+def register_config(config, Option, queue):
+    config.declare(Option("osd_scrub_chunk_max", "int", 32,
+                          "objects per scan chunk (read below)"))
+    config.declare(Option("osd_mclock_scrub_reservation", "float", 2.0,
+                          "re-armed hot through the observer"))
+    chunk_max = config.get("osd_scrub_chunk_max")
+
+    def _on_change(name, value):
+        queue.configure_qos(
+            class_params={"scrub": {"reservation": float(value)}})
+
+    config.add_observer(("osd_mclock_scrub_reservation",), _on_change)
+    return chunk_max
+
+
+class ScrubScanner:
+    """Digest-batch accounting against the process-wide scrub logger:
+    every offloaded hash batch feeds the byte ledger, every aborted
+    round the abort counter."""
+
+    def __init__(self, perf):
+        self.perf = perf
+        self.perf.add("bytes_hashed",
+                      description="fed on every digest batch below")
+        self.perf.add("aborts",
+                      description="fed on every aborted round below")
+
+    def batch_done(self, nbytes):
+        self.perf.inc("bytes_hashed", nbytes)
+
+    def aborted(self):
+        self.perf.inc("aborts")

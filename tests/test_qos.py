@@ -380,12 +380,20 @@ def test_queue_mclock_respects_object_windows():
 
 
 def test_profile_replaces_hardcoded_weights():
-    """Satellite fix: classes are declared in the profile; the phantom
-    `scrub` class is gone from the default, and an undeclared producer
-    class late-registers instead of KeyError-ing."""
+    """Classes are declared in the profile — scrub and snaptrim are
+    REAL declared background customers now (with reservations, not
+    late-registered wrr=1 defaults) — and an undeclared producer class
+    still late-registers instead of KeyError-ing."""
     prof = default_profile()
-    assert set(prof.wrr_weights()) == {"client", "recovery"}
-    assert ShardedOpQueue.WEIGHTS == {"client": 4, "recovery": 1}
+    assert set(prof.wrr_weights()) == {"client", "recovery", "scrub",
+                                       "snaptrim"}
+    assert ShardedOpQueue.WEIGHTS == {"client": 4, "recovery": 1,
+                                      "scrub": 1, "snaptrim": 1}
+    for name, reservation in (("scrub", 2.0), ("snaptrim", 1.0)):
+        spec = prof.spec(name)
+        assert spec.background
+        assert spec.reservation == reservation
+        assert spec.weight < 1.0
 
     async def body():
         q = ShardedOpQueue(num_shards=1)

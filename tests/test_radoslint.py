@@ -52,6 +52,8 @@ def lint(path, rules):
      "decl_use_pipeline_good.py"),
     ("decl-use", "decl_use_qos_bad.py", 2,
      "decl_use_qos_good.py"),
+    ("decl-use", "decl_use_scrub_bad.py", 2,
+     "decl_use_scrub_good.py"),
     ("decl-use", "decl_use_flight_bad.py", 2,
      "decl_use_flight_good.py"),
     ("decl-use", "decl_use_tracer_bad.py", 2,

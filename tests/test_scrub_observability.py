@@ -1,0 +1,302 @@
+"""Deep-scrub observability: offloaded digest batching (bit-identical
+to the host path), chunked progress + perf accounting, the scrub->mgr
+health pipeline (PG_DAMAGED / OSD_SCRUB_ERRORS raised on detection and
+cleared by a clean round), the inconsistent-object registry + admin
+verb, per-PG task handles from the scrub trigger, and scrub
+determinism under the interleave explorer.
+
+Reference surfaces: src/osd/scrubber/ (chunked scrub state machine),
+src/mon/health_check.h + src/mgr/DaemonHealthMetric (health fan-in),
+rados list-inconsistent-obj."""
+from __future__ import annotations
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.mgr import DaemonStateIndex, MgrClient, MgrDaemon
+from ceph_tpu.mon.monitor import MgrMonitor
+from ceph_tpu.native import ec_native
+from ceph_tpu.offload import service as offload
+from ceph_tpu.osd import scrub as scrub_mod
+from ceph_tpu.qa import interleave
+
+from tests.test_cluster import ClusterHarness, fast_timers, run  # noqa: F401
+from tests.test_ec_rmw import make_ec_cluster
+from tests.test_scrub import _corrupt_in_store, _find_holder
+
+
+@pytest.fixture(autouse=True)
+def fast_reporting(monkeypatch):
+    """Tight report/beacon periods so mgr fan-in converges in test
+    time (same cadence the mgr report tests pin)."""
+    monkeypatch.setattr(MgrClient, "REPORT_PERIOD", 0.2)
+    monkeypatch.setattr(MgrDaemon, "TICK_INTERVAL", 0.2)
+    monkeypatch.setattr(MgrDaemon, "REPORT_PERIOD", 0.2)
+    monkeypatch.setattr(DaemonStateIndex, "STALE_AFTER", 5.0)
+    monkeypatch.setattr(MgrMonitor, "BEACON_GRACE", 5.0)
+
+
+def _primary_pg(c, oid=None):
+    for osd in c.osds.values():
+        for pg in osd.pgs.values():
+            if pg.is_primary() and (oid is None
+                                    or oid in pg.list_objects()):
+                return pg
+    raise AssertionError("no primary pg")
+
+
+async def _http_get(addr, path: str) -> str:
+    reader, writer = await asyncio.open_connection(*addr)
+    writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+    await writer.drain()
+    blob = await reader.read()
+    writer.close()
+    return blob.split(b"\r\n\r\n", 1)[1].decode()
+
+
+def test_offload_digest_batch_bit_identical_to_host():
+    """The exact batch shape scrub builds (ragged objects zero-padded
+    into per-object (n, block) arrays) hashes bit-identically through
+    the offload service and the host ec_native path — the invariant
+    that lets different OSDs mix device and host hashing in one
+    cluster without digest-vote splits."""
+    async def body():
+        svc = offload.get_service()
+        rng = np.random.default_rng(7)
+        for block in (512, 4096):
+            batch = []
+            for ln in (block, 3 * block, 5 * block + 17, 1, block - 1):
+                data = rng.integers(0, 256, ln, dtype=np.uint8)
+                n, tail = divmod(len(data), block)
+                if tail:
+                    buf = np.zeros((n + 1) * block, dtype=np.uint8)
+                    buf[:len(data)] = data
+                    n += 1
+                else:
+                    buf = data
+                batch.append(buf.reshape(n, block))
+            device = np.asarray(await svc.crc32c_blocks(batch, block))
+            host = ec_native.crc32c_blocks(
+                np.concatenate([b.reshape(-1) for b in batch]), block)
+            assert device.dtype == np.uint32
+            assert np.array_equal(device, host), block
+            # and the whole-object fold over those block crcs is a pure
+            # function of (crcs, length): same inputs, same digest
+            ofs = 0
+            for b, ln in zip(batch, (block, 3 * block, 5 * block + 17,
+                                     1, block - 1)):
+                mine = host[ofs:ofs + b.shape[0]]
+                ofs += b.shape[0]
+                assert (scrub_mod._fold_digest(mine, ln)
+                        == scrub_mod._fold_digest(np.array(mine), ln))
+    run(body())
+
+
+def test_scrub_progress_chunking_and_perf_accounting(tmp_path):
+    """A deep scrub over many objects reports chunked progress
+    (osd_scrub_chunk_max paces the scan), lands byte/object totals in
+    the result and the cumulative pg.scrub_stats, stamps
+    last_deep_scrub, and feeds the process-wide "scrub" perf logger."""
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3, pg_num=1)
+        try:
+            n_obj = 9
+            for i in range(n_obj):
+                await io.write_full(f"o{i}", os.urandom(2 * 8192 + i))
+            for o in c.osds.values():
+                o.config.set("osd_scrub_chunk_max", 2)
+            perf = scrub_mod.scrub_perf()
+            before = perf.dump()
+            pg = _primary_pg(c, "o0")
+            res = await pg.scrub(deep=True)
+            assert res["errors"] == 0
+            assert res["objects"] == n_obj
+            assert res["bytes_hashed"] > 0 and res["mb_s"] >= 0.0
+            assert res["duration_s"] >= 0.0
+            assert pg.last_deep_scrub_stamp > 0.0
+            assert pg.scrub_stats["objects_scrubbed"] >= n_obj
+            assert pg.scrub_stats["bytes_hashed"] >= res["bytes_hashed"]
+            prog = pg.scrub_progress
+            assert prog is not None and prog.state == "done"
+            assert prog.objects_total == n_obj
+            assert prog.objects_scrubbed == n_obj
+            d = prog.to_dict()
+            assert d["deep"] and d["bytes_per_s"] >= 0.0
+            # chunk_max=2 over 9 objects: the primary's own scan alone
+            # is >= 5 chunks; every replica scans too
+            after = perf.dump()
+            assert after["chunks"] - before["chunks"] >= 5
+            assert after["deep_rounds"] > before["deep_rounds"]
+            assert after["rounds"] > before["rounds"]
+            assert after["objects_hashed"] - before["objects_hashed"] \
+                >= n_obj
+            assert after["bytes_hashed"] - before["bytes_hashed"] \
+                >= res["bytes_hashed"]
+            assert after["digest_batch_blocks"]["count"] \
+                > before["digest_batch_blocks"]["count"]
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_bitrot_to_health_to_repair_to_clear_e2e(tmp_path):
+    """The whole pipeline: inject bit-rot -> deep scrub detects and
+    repairs -> the inconsistent-object registry + health metrics ride
+    MgrReport -> PG_DAMAGED and OSD_SCRUB_ERRORS raise at HEALTH_ERR
+    and the exporter serves ceph_scrub_* families -> a clean follow-up
+    round retires the registry -> both checks clear."""
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3, pg_num=1)
+        mgr = None
+        try:
+            payloads = {f"rot{i}": os.urandom(2 * 8192 + 64)
+                        for i in range(3)}
+            for k, v in payloads.items():
+                await io.write_full(k, v)
+            mgr = MgrDaemon(c.mon_addrs, exporter_port=0)
+            await mgr.start()
+            prim = _primary_pg(c, "rot0")
+            for k in payloads:
+                victim, vpg = _find_holder(
+                    c, k, exclude=(prim.host.whoami,))
+                _corrupt_in_store(victim, vpg, k)
+            res = await prim.scrub(deep=True)
+            assert res["errors"] == len(payloads), res
+            assert res["repaired"] == len(payloads), res
+            # the registry remembers every hit (repaired, not pending)
+            assert set(prim.inconsistent_objects) == set(payloads)
+            assert all(e["repaired"] and not e["pending"]
+                       for e in prim.inconsistent_objects.values())
+            inc = prim.host._list_inconsistent(None)
+            assert inc["objects"] == len(payloads)
+            (entries,) = inc["inconsistent"].values()
+            assert {e["oid"] for e in entries} == set(payloads)
+            # flight crumbs for every mismatch and repair
+            from ceph_tpu.utils import flight
+            mism = flight.dump(etype="scrub_mismatch")["events"]
+            assert {e["detail"]["oid"] for e in mism} >= set(payloads)
+            reps = flight.dump(etype="scrub_repair")["events"]
+            assert {e["detail"]["oid"] for e in reps} >= set(payloads)
+
+            async def health():
+                return await cl.command({"prefix": "health detail"})
+
+            deadline = asyncio.get_running_loop().time() + 25
+            while True:
+                h = await health()
+                if ("PG_DAMAGED" in h["checks"]
+                        and "OSD_SCRUB_ERRORS" in h["checks"]):
+                    break
+                assert asyncio.get_running_loop().time() < deadline, h
+                await asyncio.sleep(0.2)
+            assert h["status"] == "HEALTH_ERR", h
+            assert h["checks"]["OSD_SCRUB_ERRORS"]["severity"] \
+                == "HEALTH_ERR"
+            assert "inconsistent" in h["checks"]["PG_DAMAGED"]["summary"]
+
+            # the exporter serves per-pool scrub families meanwhile
+            text = await _http_get(mgr.exporter.addr, "/metrics")
+            assert "# TYPE ceph_scrub_errors_found counter" in text
+            line = next(ln for ln in text.splitlines()
+                        if ln.startswith("ceph_scrub_inconsistent{"))
+            assert 'pool="' in line
+            assert float(line.split()[-1]) == len(payloads)
+
+            # a clean same-depth round retires the registry -> clears
+            res = await prim.scrub(deep=True)
+            assert res["errors"] == 0, res
+            assert prim.inconsistent_objects == {}
+            deadline = asyncio.get_running_loop().time() + 25
+            while True:
+                h = await health()
+                if ("PG_DAMAGED" not in h["checks"]
+                        and "OSD_SCRUB_ERRORS" not in h["checks"]):
+                    break
+                assert asyncio.get_running_loop().time() < deadline, h
+                await asyncio.sleep(0.2)
+            for k, v in payloads.items():
+                assert await io.read(k) == v
+        finally:
+            if mgr is not None:
+                await mgr.stop()
+            await c.stop()
+    run(body())
+
+
+def test_scrub_trigger_returns_per_pg_handles(tmp_path):
+    """The scrub trigger spawns one reaped task per primary PG and
+    says which; scrub_all drains them and hands back the per-PG result
+    dicts (crashed/cancelled PGs report None, not an exception)."""
+    async def body():
+        c = ClusterHarness(tmp_path, n_osds=3)
+        try:
+            await c.start()
+            cl = await c.client()
+            await cl.pool_create("rbd", pg_num=4, size=3)
+            io = cl.ioctx("rbd")
+            for i in range(8):
+                await io.write_full(f"h{i}", b"x" * 4096)
+            osd = next(o for o in c.osds.values()
+                       if any(pg.is_primary() for pg in o.pgs.values()))
+            n_prim = sum(1 for pg in osd.pgs.values()
+                         if pg.is_primary() and pg.state == "active")
+            results = await osd.scrub_all(deep=True)
+            assert len(results) == n_prim
+            for key, res in results.items():
+                assert res is not None and res["deep"], (key, res)
+                assert res["errors"] == 0
+            trig = osd._trigger_scrub(False)
+            assert trig["scheduled"] == n_prim and not trig["deep"]
+            assert sorted(trig["pgs"]) == sorted(results)
+            # the fire-and-forget tasks drain through the bg reaper:
+            # every primary finishes a LIGHT round (replacing the deep
+            # round's progress record above)
+            deadline = asyncio.get_running_loop().time() + 10
+            while not all(pg.scrub_progress is not None
+                          and not pg.scrub_progress.deep
+                          and pg.scrub_progress.state == "done"
+                          for pg in osd.pgs.values()
+                          if pg.is_primary()):
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+        finally:
+            await c.stop()
+    run(body())
+
+
+def test_scrub_deterministic_under_interleave_explorer(tmp_path):
+    """Seeded schedule shuffles must not change what scrub computes:
+    each round re-injects the same rot, and every explored deep scrub
+    reports the identical verdict (and repairs back to the identical
+    bytes) as the unexplored control round."""
+    async def body():
+        c, cl, io = await make_ec_cluster(tmp_path, 2, 1, 3, pg_num=1)
+        try:
+            payload = os.urandom(3 * 8192 + 11)
+            await io.write_full("det", payload)
+            await io.write_full("clean", os.urandom(8192))
+            prim = _primary_pg(c, "det")
+
+            async def round_():
+                victim, vpg = _find_holder(
+                    c, "det", exclude=(prim.host.whoami,))
+                _corrupt_in_store(victim, vpg, "det")
+                res = await prim.scrub(deep=True)
+                return (res["errors"], res["repaired"],
+                        res["inconsistent"], res.get("unrepaired", []),
+                        res["objects"], res["bytes_hashed"],
+                        await io.read("det") == payload)
+
+            control = await round_()
+            assert control[:2] == (1, 1) and control[-1]
+            for seed in (1, 2, 3):
+                async with interleave.explore(seed) as ex:
+                    got = await round_()
+                assert ex.decisions > 0
+                assert got == control, f"seed {seed} diverged"
+        finally:
+            await c.stop()
+    run(body())
