@@ -569,6 +569,9 @@ class MgrDaemon(Dispatcher):
     REPORT_PERIOD = 1.0         # handed to daemons via MMgrConfigure
     NEARFULL_RATIO = 0.85       # mon_osd_nearfull_ratio analog
     FULL_RATIO = 0.95           # mon_osd_full_ratio analog
+    # an inter-OSD wait this old is suspect even without a visible
+    # cycle (the other half may sit on a daemon that is not reporting)
+    DEADLOCK_EDGE_AGE_S = 15.0
 
     def __init__(self, mon_addrs, modules: list[MgrModule] | None = None,
                  auth_key: bytes | None = None,
@@ -670,6 +673,13 @@ class MgrDaemon(Dispatcher):
                 lambda req: self.trace_slowest(
                     int(req.get("n", 10)), req.get("class")),
                 "slowest assembled traces: [n=10] [class=<op class>]")
+            self.asok.register_command(
+                "deadlock status",
+                lambda req: self.deadlock_status(),
+                "cross-daemon wait-for graph assembled from the "
+                "per-OSD lockdep wait annotations: long-parked waits, "
+                "inter-OSD edges, cycles, over-age edges — the "
+                "DEADLOCK_SUSPECTED inputs")
         self.addr: tuple[str, int] | None = None
         # True while the mgrmap names us active; standbys keep their
         # (empty) digest to themselves so they can never overwrite the
@@ -902,6 +912,9 @@ class MgrDaemon(Dispatcher):
         # at detection and clear after the next verified-clean round
         scrub_err = []          # (daemon, inconsistent, unrepaired)
         damaged_pgs = 0
+        # long-parked lock/grant waits from every reporting daemon:
+        # the cross-daemon wait-for graph's raw rows
+        deadlock_rows: list[dict] = []
         # per-client SLO surface (OpTracker ClientTable health metrics)
         slo_total = 0
         slo_clients: dict[str, int] = {}
@@ -946,6 +959,8 @@ class MgrDaemon(Dispatcher):
                 if cur is None or float(s.get("p99_ms") or 0.0) \
                         > float(cur.get("p99_ms") or 0.0):
                     slow_clients[c] = dict(s, osd=name)
+            for r in hm.get("deadlock") or []:
+                deadlock_rows.append(dict(r, daemon=name))
             sc = hm.get("scrub") or {}
             if sc.get("inconsistent_objects"):
                 scrub_err.append((name,
@@ -1043,6 +1058,26 @@ class MgrDaemon(Dispatcher):
                 "detail": [f"{d}: {n} objects in the inconsistent "
                            f"registry (list-inconsistent-obj)"
                            for d, n, _ in scrub_err]}
+        # class-qualified: the digest must stay computable when driven
+        # unbound against a bare daemon-state stub (no mgr methods)
+        dl = MgrDaemon._assemble_deadlock(self, deadlock_rows)
+        if dl["cycles"] or dl["over_age_edges"]:
+            # suspicion, not proof: the check clears by itself once the
+            # abort path (reservation timeout) drains the annotations
+            detail = []
+            for cyc in dl["cycles"]:
+                detail.append("cycle: " + " -> ".join(cyc))
+            for e in dl["over_age_edges"]:
+                detail.append(f"{e['waiter']} waiting "
+                              f"{e['age_s']:.1f}s on {e['resource']} "
+                              f"held by {e['holder']} "
+                              f"(task {e['task']}, tid {e['tid']})")
+            checks["DEADLOCK_SUSPECTED"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{len(dl['cycles'])} wait-for cycles, "
+                           f"{len(dl['over_age_edges'])} over-age "
+                           f"inter-OSD waits (deadlock status)",
+                "detail": detail}
         if offload_degraded:
             # the EC data path still serves (host-codec fallback is
             # bit-identical) but at host speed: warn, don't err
@@ -1059,6 +1094,74 @@ class MgrDaemon(Dispatcher):
                                    "age_s": round(st.age, 2)}
                             for name, st in
                             sorted(self.daemon_index.daemons.items())}}
+
+    def _assemble_deadlock(self, rows: list[dict]) -> dict:
+        """Cross-daemon wait-for graph from the per-OSD lockdep wait
+        annotations (the distributed half of asynclockdep). Nodes are
+        daemon entities; a row whose `peer` names another OSD is a
+        directed edge waiter -> holder — a remote scrub reservation
+        parked on that peer's slot pool. A cycle is two (or more)
+        primaries holding their own slot while waiting on each other's:
+        the crossed-reservation deadlock the reservation timeout must
+        break. Rows without a peer (local waits) are kept for
+        attribution but contribute no inter-daemon edge."""
+        edges = []
+        for r in rows:
+            if r.get("peer") is None:
+                continue
+            edges.append({"waiter": r.get("entity"),
+                          "holder": f"osd.{r['peer']}",
+                          "resource": r.get("resource"),
+                          "kind": r.get("kind"),
+                          "tid": r.get("tid"),
+                          "age_s": float(r.get("age_s") or 0.0),
+                          "task": r.get("task"),
+                          "site": r.get("site")})
+        succ: dict[str, set] = {}
+        for e in edges:
+            if e["waiter"]:
+                succ.setdefault(e["waiter"], set()).add(e["holder"])
+        cycles: list[list[str]] = []
+        seen: set[frozenset] = set()
+        visited: set[str] = set()
+
+        def dfs(node: str, path: list, on_path: dict) -> None:
+            on_path[node] = len(path)
+            path.append(node)
+            for nxt in sorted(succ.get(node, ())):
+                if nxt in on_path:
+                    ring = path[on_path[nxt]:]
+                    key = frozenset(ring)
+                    if key not in seen:
+                        seen.add(key)
+                        cycles.append(ring + [nxt])
+                elif nxt not in visited:
+                    dfs(nxt, path, on_path)
+            path.pop()
+            del on_path[node]
+            visited.add(node)
+
+        for start in sorted(succ):
+            if start not in visited:
+                dfs(start, [], {})
+        over_age = [e for e in edges
+                    if e["age_s"] >= getattr(
+                        self, "DEADLOCK_EDGE_AGE_S",
+                        MgrDaemon.DEADLOCK_EDGE_AGE_S)]
+        return {"waits": rows, "edges": edges, "cycles": cycles,
+                "over_age_edges": over_age}
+
+    def deadlock_status(self) -> dict:
+        """`deadlock status` admin-socket verb: assemble the graph
+        fresh from the daemon index, so it answers even on a standby
+        mgr and between digest ticks."""
+        rows: list[dict] = []
+        for name, st in sorted(self.daemon_index.daemons.items()):
+            for r in (st.health_metrics or {}).get("deadlock") or []:
+                rows.append(dict(r, daemon=name))
+        out = self._assemble_deadlock(rows)
+        out["suspected"] = bool(out["cycles"] or out["over_age_edges"])
+        return out
 
     def module_status(self) -> dict:
         return {m.NAME: m.status() for m in self.modules}

@@ -344,3 +344,11 @@ MOSDRepScrub = _simple(0x80, "MOSDRepScrub")        # {"pgid", "tid", "from",
                                                     # scan names lo < n <= hi
 MOSDRepScrubMap = _simple(0x81, "MOSDRepScrubMap")  # {"pgid", "tid", "from",
                                                     #  "map": {oid: entry}}
+MOSDScrubReserve = _simple(0x82, "MOSDScrubReserve")  # remote range
+                                                    # reservation handshake
+                                                    # (src/messages/
+                                                    #  MOSDScrubReserve.h):
+                                                    # {"pgid", "tid", "from",
+                                                    #  "op": "reserve"|
+                                                    #  "grant"|"reject"|
+                                                    #  "release"}

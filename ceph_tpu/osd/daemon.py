@@ -27,7 +27,7 @@ from ceph_tpu.msg.messages import (Message, MOSDOp, MOSDOpReply,
                                    MOSDPGLog, MOSDPGPush, MOSDPGPushReply,
                                    MOSDPGQuery, MOSDRepOp, MOSDRepOpReply,
                                    MOSDRepScrub, MOSDRepScrubMap,
-                                   MPing, MPingReply)
+                                   MOSDScrubReserve, MPing, MPingReply)
 from ceph_tpu.msg.messenger import Connection, Dispatcher, Messenger, Policy
 from ceph_tpu.mon.mon_client import MonClient
 from ceph_tpu.objectstore.memstore import MemStore
@@ -98,6 +98,19 @@ class OSD(Dispatcher):
             Option("osd_scrub_sleep", "float", 0.0,
                    "seconds slept between scrub scan chunks (throttle "
                    "on top of the QoS pacing; hot)", minimum=0.0),
+            Option("osd_scrub_reserve", "bool", True,
+                   "reserve one scrub slot on every acting-set member "
+                   "before a round may gate client writes (the "
+                   "reference's scrub reserver; hot)"),
+            Option("osd_scrub_reserve_timeout", "float", 10.0,
+                   "seconds a primary waits for a local or remote "
+                   "scrub reservation before aborting the round — the "
+                   "path that breaks crossed-reservation deadlocks "
+                   "(hot)", minimum=0.1),
+            Option("osd_max_scrubs", "int", 1,
+                   "concurrent scrub rounds this daemon will take part "
+                   "in, as primary or replica (hot: resizes the live "
+                   "reservation pool)", minimum=1),
             Option("osd_op_num_shards", "int", self.NUM_OP_SHARDS,
                    "op queue shards (startup only)", minimum=1),
             Option("osd_max_recovery_in_flight", "int",
@@ -446,6 +459,20 @@ class OSD(Dispatcher):
             self.config.get("osd_max_recovery_in_flight"))
         self.config.add_observer(("osd_max_recovery_in_flight",),
                                  self._on_recovery_slots)
+        # host-wide scrub slots (osd_max_scrubs): a round — primary- or
+        # replica-side — holds one for its whole duration. Named, so
+        # when lockdep is armed every park on the pool is a tracked
+        # wait and every holder a tracked task; the entity detail rides
+        # into the mgr deadlock annotations.
+        self.scrub_reservations = AdjustableSemaphore(
+            self.config.get("osd_max_scrubs"),
+            name=f"osd.{self.whoami}:scrub_reservations")
+        self.scrub_reservations.lockdep_detail = {
+            "entity": f"osd.{self.whoami}"}
+        # remote grants held for other primaries: (pool, ps, tid, from)
+        self._scrub_remote_grants: set[tuple] = set()
+        self.config.add_observer(("osd_max_scrubs",),
+                                 self._on_scrub_slots)
         # fault injection: a hang deadline makes dispatch swallow
         # everything (peers see heartbeat silence -> mark-down); the
         # crash task is deliberately NOT in _bg_tasks (it runs stop(),
@@ -569,6 +596,11 @@ class OSD(Dispatcher):
                 # PG_DAMAGED / OSD_SCRUB_ERRORS, per-pool table
                 # aggregated into the ceph_scrub_* exporter families
                 "scrub": self._scrub_health_metrics(),
+                # long-parked lock/grant waits annotated with (entity,
+                # resource, peer, tid): the rows the mgr assembles into
+                # its cross-daemon wait-for graph (DEADLOCK_SUSPECTED)
+                "deadlock": sanitizer.wait_annotations(
+                    entity=f"osd.{self.whoami}"),
                 "store": self.store.statfs()}
 
     def _mgr_device_metrics(self) -> dict:
@@ -692,6 +724,10 @@ class OSD(Dispatcher):
         """osd_max_recovery_in_flight observer: resize the live slot
         pool."""
         self._run_on_loop(self.recovery_reservations.resize, int(value))
+
+    def _on_scrub_slots(self, name: str, value) -> None:
+        """osd_max_scrubs observer: resize the live scrub slot pool."""
+        self._run_on_loop(self.scrub_reservations.resize, int(value))
 
     def _inject_admin(self, req: dict) -> dict:
         """`inject` admin-socket verbs — the same injector the config
@@ -1276,6 +1312,21 @@ class OSD(Dispatcher):
             pg = self._pg_of(msg)
             if pg is not None:
                 pg.handle_scrub_map(msg)
+            return True
+        if isinstance(msg, MOSDScrubReserve):
+            pg = self._pg_of(msg, create=True)
+            if pg is not None:
+                if msg.payload.get("op") == "reserve":
+                    # a reserve can park on the slot pool for seconds:
+                    # never on the dispatch loop, or every other
+                    # message from this peer (replication sub-ops,
+                    # heartbeats on shared conns) stalls behind it
+                    t = asyncio.get_running_loop().create_task(
+                        scrub_mod.handle_scrub_reserve(self, pg, msg))
+                    self._notify_tasks.add(t)
+                    t.add_done_callback(self._notify_tasks.discard)
+                else:
+                    await scrub_mod.handle_scrub_reserve(self, pg, msg)
             return True
         from ceph_tpu.msg.messages import MWatchNotifyAck
         if isinstance(msg, MWatchNotifyAck):

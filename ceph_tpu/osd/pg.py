@@ -85,6 +85,9 @@ class PGInstance:
         self._recovery_task: asyncio.Task | None = None
         # scrub: (tid, peer) -> future resolving to the peer's scrub map
         self._scrub_waiters: dict[tuple, asyncio.Future] = {}
+        # scrub reservations: (tid, peer) -> future resolving True on
+        # grant / False on reject (MOSDScrubReserve round-trips)
+        self._reserve_waiters: dict[tuple, asyncio.Future] = {}
         self.last_scrub: dict | None = None
         self._scrub_lock = asyncio.Lock()
         # scrub observability: live round progress, wall-clock stamps,

@@ -57,9 +57,10 @@ from typing import TYPE_CHECKING
 
 import numpy as np
 
-from ceph_tpu.msg.messages import MOSDRepScrub, MOSDRepScrubMap
+from ceph_tpu.msg.messages import (MOSDRepScrub, MOSDRepScrubMap,
+                                   MOSDScrubReserve)
 from ceph_tpu.objectstore.store import StoreError
-from ceph_tpu.utils import flight
+from ceph_tpu.utils import flight, sanitizer
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import (TYPE_HISTOGRAM,
                                           PerfCountersCollection)
@@ -122,6 +123,10 @@ def scrub_perf():
                description="scan chunks that proceeded after their QoS "
                            "grant timed out (forward-progress escape "
                            "hatch)")
+        pc.add("reserve_failures",
+               description="scrub rounds aborted because an acting-set "
+                           "reservation timed out or was rejected (the "
+                           "crossed-reservation deadlock breaker)")
         pc.add("digest_batch_blocks", type=TYPE_HISTOGRAM,
                description="blocks per offloaded digest batch")
         pc.add("digest_batch_us", type=TYPE_HISTOGRAM,
@@ -407,6 +412,178 @@ def _note_repaired(pg: "PGInstance", oid: str, osd: int, ok: bool,
         entry["repaired"] = True
 
 
+async def _reserve_acting_set(pg: "PGInstance",
+                              tid: int) -> tuple[bool, list[int]]:
+    """Claim one `osd_max_scrubs` slot on self and every up acting
+    peer before the round may gate client writes (the reference's
+    scrub reserver: OSD::sched_scrub + MOSDScrubReserve). Local slot
+    first, then peers in ascending id, every wait bounded by
+    `osd_scrub_reserve_timeout`: crossed reservations between two
+    primaries therefore stall only until one side's timeout fires,
+    releases everything it holds, and retries later — the abort path
+    that breaks the cycle. While a remote wait is parked it is
+    registered with lockdep under the PEER's slot name, which is the
+    inter-OSD edge the in-process watchdog and the mgr's cross-daemon
+    wait-for graph report."""
+    host = pg.host
+    sem = getattr(host, "scrub_reservations", None)
+    if sem is None:
+        return True, []
+    timeout = float(_cfg(pg, "osd_scrub_reserve_timeout", 10.0))
+    me = f"osd.{host.whoami}"
+    try:
+        await sem.acquire_timeout(timeout)
+    except asyncio.TimeoutError:
+        scrub_perf().inc("reserve_failures")
+        flight.record("scrub_reserve_fail", f"pg.{pg.pgid}", tid=tid,
+                      stage="local", waited_s=timeout)
+        return False, []
+    granted: list[int] = []
+    released = False
+    try:
+        for peer in sorted(pg.acting_peers()):
+            if not host.osdmap.is_up(peer):
+                continue
+            fut = asyncio.get_running_loop().create_future()
+            pg._reserve_waiters[(tid, peer)] = fut
+            token = sanitizer.lockdep_wait_start(
+                f"osd.{peer}:scrub_reservations", kind="remote_reserve",
+                entity=me, peer=peer, tid=tid, pgid=str(pg.pgid))
+            ok, reason = False, "rejected"
+            try:
+                await host.send_osd(peer, MOSDScrubReserve(
+                    {"pgid": [pg.pgid.pool, pg.pgid.ps], "tid": tid,
+                     "from": host.whoami, "op": "reserve"}))
+                ok = bool(await asyncio.wait_for(fut, timeout))
+            except asyncio.TimeoutError:
+                reason = "timeout"
+            except Exception as e:
+                reason = f"{type(e).__name__}: {e}"
+            finally:
+                sanitizer.lockdep_wait_end(token)
+                pg._reserve_waiters.pop((tid, peer), None)
+            if ok:
+                granted.append(peer)
+                continue
+            scrub_perf().inc("reserve_failures")
+            flight.record("scrub_reserve_fail", f"pg.{pg.pgid}", tid=tid,
+                          stage=f"osd.{peer}", reason=reason,
+                          waited_s=timeout)
+            dout("scrub", 2, f"pg {pg.pgid} scrub reservation on "
+                             f"osd.{peer} failed ({reason}): aborting "
+                             f"round")
+            released = True
+            await _release_acting_set(pg, tid, granted)
+            return False, []
+    except BaseException:
+        # a CancelledError (round reaped at daemon stop, drained round
+        # interrupted) is not an Exception: without this the local slot
+        # acquired above — and any grants already collected — would
+        # leak, wedging every later round on this daemon's semaphore
+        if not released:
+            await _release_acting_set(pg, tid, granted)
+        raise
+    return True, granted
+
+
+async def _release_acting_set(pg: "PGInstance", tid: int,
+                              granted: list[int]) -> None:
+    """Return the local slot and every remote grant of this round.
+    Releasing local FIRST unparks any peer's reserve handler queued on
+    our slot — in the crossed-primaries deadlock this is the edge that
+    must break before the other side can make progress."""
+    host = pg.host
+    sem = getattr(host, "scrub_reservations", None)
+    if sem is not None:
+        sem.release()
+    interrupted: asyncio.CancelledError | None = None
+    for peer in granted:
+        try:
+            await host.send_osd(peer, MOSDScrubReserve(
+                {"pgid": [pg.pgid.pool, pg.pgid.ps], "tid": tid,
+                 "from": host.whoami, "op": "release"}))
+        # deferred re-raise below: every granted peer must get its
+        # release even when this round is being cancelled, or the
+        # peer's slot stays taken until its own stale-grant churn
+        # radoslint: disable-next=cancellation-swallow
+        except asyncio.CancelledError as e:
+            interrupted = e
+        except Exception as e:
+            dout("scrub", 2,
+                 f"scrub reserve release to osd.{peer} failed: {e}")
+    if interrupted is not None:
+        raise interrupted
+
+
+async def handle_scrub_reserve(host, pg: "PGInstance", msg) -> None:
+    """Both halves of the reservation wire protocol.
+
+    Replica (`op=reserve`): park — bounded — on the local slot on the
+    requesting primary's behalf, then grant; a timeout rejects. The
+    park is a real AdjustableSemaphore acquire, so it shows up in this
+    daemon's lockdep waits/holders and in its mgr deadlock
+    annotations.
+
+    Primary (`op=grant|reject`): resolve the round's waiter. A grant
+    with no waiter means the round already aborted; the slot is handed
+    straight back (`op=release`) so a slow peer never leaks it.
+
+    Anyone (`op=release`): free a slot previously granted to this
+    requester."""
+    p = msg.payload
+    op, tid, frm = p.get("op"), p.get("tid"), p.get("from")
+    key = (pg.pgid.pool, pg.pgid.ps, tid, frm)
+    sem = getattr(host, "scrub_reservations", None)
+    if op == "reserve":
+        granted = True
+        if sem is not None:
+            # wait longer than the requester will: the reject path is
+            # for a genuinely wedged slot, not a normally-busy one —
+            # the primary's own timeout aborts first and the grant
+            # that eventually lands is bounced back as stale
+            timeout = 4.0 * float(_cfg(pg, "osd_scrub_reserve_timeout",
+                                       10.0))
+            try:
+                await sem.acquire_timeout(timeout)
+                host._scrub_remote_grants.add(key)
+            except asyncio.TimeoutError:
+                granted = False
+        try:
+            await host.send_osd(frm, MOSDScrubReserve(
+                {"pgid": [pg.pgid.pool, pg.pgid.ps], "tid": tid,
+                 "from": host.whoami,
+                 "op": "grant" if granted else "reject"}))
+        except asyncio.CancelledError:
+            # handler reaped mid-reply (daemon stop): the grant never
+            # reached the requester, so nobody will ever release it —
+            # hand the slot back before unwinding
+            if granted and sem is not None:
+                host._scrub_remote_grants.discard(key)
+                sem.release()
+            raise
+        except Exception as e:
+            dout("scrub", 2, f"scrub reserve reply to osd.{frm} "
+                             f"failed: {e}")
+            if granted and sem is not None:
+                host._scrub_remote_grants.discard(key)
+                sem.release()
+    elif op in ("grant", "reject"):
+        fut = pg._reserve_waiters.get((tid, frm))
+        if fut is not None and not fut.done():
+            fut.set_result(op == "grant")
+        elif op == "grant":
+            try:
+                await host.send_osd(frm, MOSDScrubReserve(
+                    {"pgid": [pg.pgid.pool, pg.pgid.ps], "tid": tid,
+                     "from": host.whoami, "op": "release"}))
+            except Exception:
+                pass
+    elif op == "release":
+        if sem is not None and key in host._scrub_remote_grants:
+            host._scrub_remote_grants.discard(key)
+            sem.release()
+
+
 async def scrub_pg(pg: "PGInstance", deep: bool) -> dict:
     """Primary-side scrub round, range-gated like the reference's
     chunky scrub: the namespace is walked in sorted-name ranges and
@@ -506,23 +683,46 @@ async def _scrub_locked(pg: "PGInstance", deep: bool,
     result: dict = {"errors": 0, "repaired": 0,
                     "inconsistent": [], "unrepaired": []}
     seen_osds = {host.whoami}
-    for i, rng in enumerate(ranges):
-        # pace UNGATED: while scrub waits for its dmclock turn (and
-        # between ranges) client writes flow freely — this is where
-        # the QoS class actually shapes scrub against foreground load
-        await _qos_grant(pg)
-        await pg.block_writes()
-        try:
-            r = await _scrub_range(pg, deep, rng, progress)
-        finally:
-            pg.unblock_writes()
-        result["errors"] += r["errors"]
-        result["repaired"] += r["repaired"]
-        result["inconsistent"].extend(r["inconsistent"])
-        result["unrepaired"].extend(r.get("unrepaired", []))
-        seen_osds.update(r["osds"])
-        if sleep_s > 0 and i + 1 < len(ranges):
-            await asyncio.sleep(sleep_s)
+
+    # reserve one scrub slot per acting-set member for the WHOLE round
+    # (sched_scrub's reserver): osd_max_scrubs bounds concurrent rounds
+    # per daemon cluster-wide, and a failed/timed-out reservation
+    # aborts cleanly before any write gate was ever taken
+    reserve_tid = pg.backend.new_tid()
+    reserved, reserved_peers = False, []
+    if bool(_cfg(pg, "osd_scrub_reserve", True)):
+        ok, reserved_peers = await _reserve_acting_set(pg, reserve_tid)
+        reserved = ok and getattr(host, "scrub_reservations",
+                                  None) is not None
+        if not ok:
+            progress.finish("reserve_failed")
+            result.update({"reserve_failed": True, "deep": deep,
+                           "osds": sorted(seen_osds), "objects": 0,
+                           "bytes_hashed": 0, "duration_s": round(
+                               time.monotonic() - t0, 3), "mb_s": 0.0})
+            return result
+    try:
+        for i, rng in enumerate(ranges):
+            # pace UNGATED: while scrub waits for its dmclock turn (and
+            # between ranges) client writes flow freely — this is where
+            # the QoS class actually shapes scrub against foreground
+            # load
+            await _qos_grant(pg)
+            await pg.block_writes()
+            try:
+                r = await _scrub_range(pg, deep, rng, progress)
+            finally:
+                pg.unblock_writes()
+            result["errors"] += r["errors"]
+            result["repaired"] += r["repaired"]
+            result["inconsistent"].extend(r["inconsistent"])
+            result["unrepaired"].extend(r.get("unrepaired", []))
+            seen_osds.update(r["osds"])
+            if sleep_s > 0 and i + 1 < len(ranges):
+                await asyncio.sleep(sleep_s)
+    finally:
+        if reserved:
+            await _release_acting_set(pg, reserve_tid, reserved_peers)
 
     result["deep"] = deep
     result["osds"] = sorted(seen_osds)

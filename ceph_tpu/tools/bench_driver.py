@@ -1517,6 +1517,142 @@ def stage_failure_storm() -> dict:
         results["failure_storm_drill_error"] = \
             f"{type(e).__name__}: {e}"
         log(f"failure_storm drill failed: {type(e).__name__}: {e}")
+
+    # -- phase D: asynclockdep drill — two primaries cross their scrub
+    # reservations (each holds its own osd_max_scrubs slot while
+    # reserving the other's). The in-process watchdog must see the
+    # wait-for cycle while it is LIVE, the mgr must raise
+    # DEADLOCK_SUSPECTED from the shipped wait annotations and clear it
+    # once the reservation-timeout abort breaks the cross, and a replay
+    # must reproduce a bit-identical witness digest. Lockdep's client
+    # cost is A/B'd on the same write workload (trend-guarded <5%).
+    async def deadlock_drill():
+        from ceph_tpu.mgr.daemon import MgrDaemon
+        from ceph_tpu.tools.cluster_boot import ephemeral_cluster
+        from ceph_tpu.utils import sanitizer
+
+        loop = asyncio.get_running_loop()
+        ring = {"osd.0:scrub_reservations", "osd.1:scrub_reservations"}
+
+        def scrub_pgs(osds):
+            out = {}
+            for who in (0, 1):
+                for pg in osds[who].pgs.values():
+                    if pg.pool.name == "dl" and pg.is_primary() \
+                            and pg.acting_peers():
+                        out[who] = pg
+                        break
+            return out[0], out[1]
+
+        async def crossed_round(osds, mgr):
+            """One crossed-reservation deadlock: returns (in-process
+            detect latency, observed witness digest, suspected-at-mgr
+            flag, both rounds' results)."""
+            pg0, pg1 = scrub_pgs(osds)
+            t0 = loop.time()
+            s0 = asyncio.ensure_future(pg0.scrub())
+            s1 = asyncio.ensure_future(pg1.scrub())
+            detect = digest = None
+            suspected = False
+            while loop.time() - t0 < 12.0 and not (detect and suspected):
+                if detect is None:
+                    scan = sanitizer.deadlock_scan(stuck_s=0.0)
+                    for cyc in scan["cycles"]:
+                        if set(cyc["resources"]) == ring:
+                            detect = loop.time() - t0
+                            digest = cyc["digest"]
+                if not suspected:
+                    try:
+                        suspected = "DEADLOCK_SUSPECTED" in \
+                            mgr._build_digest()["checks"] \
+                            and mgr.deadlock_status()["suspected"]
+                    except Exception:
+                        suspected = False
+                await asyncio.sleep(0.05)
+            r0, r1 = await asyncio.gather(s0, s1)
+            return detect, digest, suspected, r0, r1
+
+        async with ephemeral_cluster(2, prefix="bench-dl-") \
+                as (client, osds, mon):
+            mgr = MgrDaemon(list(mon.monmap.mons.values()),
+                            modules=[], exporter_port=None)
+            await mgr.start()
+            try:
+                await client.pool_create("dl", pg_num=8, size=2)
+                io = client.ioctx("dl")
+                for i in range(8):
+                    await io.write_full(f"d{i}", b"x" * 4096)
+
+                async def client_burst(n=150, size=64 * 1024):
+                    blob = b"y" * size
+                    t = time.perf_counter()
+                    for i in range(n):
+                        await io.write_full(f"w{i % 32:02d}", blob)
+                    return time.perf_counter() - t
+
+                await client_burst(n=30)            # warm the path
+                t_off = await client_burst()        # lockdep disarmed
+                for o in osds:                      # arm via the knob
+                    o.config.set("sanitizer_stuck_wait_s", 0.4)
+                    o.config.set("sanitizer_lockdep", True)
+                t_on = await client_burst()
+                results["lockdep_overhead_pct"] = round(
+                    (t_on - t_off) / t_off * 100.0, 2)
+
+                # osd.0's shorter timeout makes it the deadlock breaker
+                osds[0].config.set("osd_scrub_reserve_timeout", 3.0)
+                osds[1].config.set("osd_scrub_reserve_timeout", 9.0)
+                detect, digest, suspected, r0, r1 = \
+                    await crossed_round(osds, mgr)
+                results["deadlock_drill_detect_s"] = \
+                    round(detect, 3) if detect is not None else None
+                results["deadlock_drill_detected"] = (
+                    detect is not None and detect < 2.0)
+                results["deadlock_drill_witness_digest"] = digest
+                results["deadlock_drill_suspected_raised"] = suspected
+                # the abort path broke the cross: the breaker bailed,
+                # the survivor's round ran to completion
+                results["deadlock_drill_broken"] = (
+                    bool(r0.get("reserve_failed"))
+                    and not r1.get("reserve_failed")
+                    and r1.get("errors") == 0)
+                # ...and the health check clears once fresh reports
+                # carry no annotations
+                cleared = False
+                deadline = loop.time() + 10.0
+                while loop.time() < deadline and not cleared:
+                    try:
+                        cleared = "DEADLOCK_SUSPECTED" not in \
+                            mgr._build_digest()["checks"]
+                    except Exception:
+                        cleared = False
+                    await asyncio.sleep(0.25)
+                results["deadlock_drill_suspected_cleared"] = cleared
+
+                # replay: the witness digest fingerprints the resource
+                # ring, not schedules or task names — a second crossed
+                # round must reproduce it bit for bit
+                detect2, digest2, _, _, _ = await crossed_round(osds,
+                                                                mgr)
+                results["deadlock_drill_replay_identical"] = (
+                    digest is not None and digest == digest2)
+                log(f"deadlock_drill: detect={detect and round(detect, 3)}s "
+                    f"suspected={suspected} cleared={cleared} "
+                    f"replay_ok={digest == digest2} "
+                    f"lockdep_overhead={results['lockdep_overhead_pct']}%")
+            finally:
+                for o in osds:
+                    try:
+                        o.config.set("sanitizer_lockdep", False)
+                    except Exception:
+                        pass
+                await mgr.stop()
+
+    try:
+        asyncio.run(asyncio.wait_for(deadlock_drill(), 140))
+    except Exception as e:
+        results["deadlock_drill_error"] = f"{type(e).__name__}: {e}"
+        log(f"deadlock_drill failed: {type(e).__name__}: {e}")
     return results
 
 
@@ -2708,7 +2844,10 @@ TREND_KEYS_COST = ("copy_amplification", "loop_busy_fraction",
                    "interleave_sanitizer_overhead_pct",
                    "flight_history_overhead_pct",
                    "failure_storm_p99_area_ms_s",
-                   "tracing_overhead_pct")
+                   "tracing_overhead_pct",
+                   # armed-vs-disarmed lockdep tax on the client write
+                   # path (deadlock_drill A/B): must stay under ~5%
+                   "lockdep_overhead_pct")
 TREND_THRESHOLD_PCT = 10.0
 
 

@@ -13,7 +13,7 @@ from ceph_tpu.tools.radoslint.core import (Finding, RULES, find_baseline,
                                            load_baseline, run_lint,
                                            write_baseline)
 from ceph_tpu.tools.radoslint import (checkers, lifetimes,  # noqa: F401
-                                      project)
+                                      lockorder, project)
 
 __all__ = ["Finding", "RULES", "run_lint", "find_baseline",
            "load_baseline", "write_baseline"]

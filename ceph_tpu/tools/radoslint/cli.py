@@ -48,7 +48,7 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     # loads the checker modules (fills core.RULES) as a side effect
     from ceph_tpu.tools.radoslint import (checkers, lifetimes,  # noqa: F401
-                                          project)
+                                          lockorder, project)
     if args.list_rules:
         for r in sorted(core.RULES.values(), key=lambda r: r.id):
             print(f"{r.id} ({r.kind})")

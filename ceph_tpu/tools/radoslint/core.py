@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 import re
@@ -30,6 +31,11 @@ import subprocess
 from typing import Callable, Iterable
 
 BASELINE_NAME = ".radoslint-baseline.json"
+CACHE_NAME = ".radoslint_cache.json"
+
+#: modules parsed since import — the cache test's instrument: a warm
+#: full-tree run must not move it
+PARSE_COUNT = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,6 +66,8 @@ class SourceFile:
     """One parsed module plus its suppression map."""
 
     def __init__(self, abspath: str, path: str, source: str):
+        global PARSE_COUNT
+        PARSE_COUNT += 1
         self.abspath = abspath
         self.path = path            # root-relative, posix separators
         self.source = source
@@ -229,49 +237,184 @@ def write_baseline(path: str, findings: Iterable[Finding | str]) -> int:
     return len(keys)
 
 
+# -- findings cache ----------------------------------------------------------
+#
+# The full-tree gate runs inside tier-1 on every test invocation, and
+# re-parsing ~170 modules to reach the same zero findings is pure waste.
+# The cache keys each file's POST-SUPPRESSION findings per rule by a
+# content hash (mtime/size are recorded for humans but identity is the
+# bytes — tmp-dir tests rewrite files faster than mtime granularity),
+# and the project-rule results by a whole-tree stamp. Any edit to the
+# linter itself (rules-hash over the package sources) invalidates
+# everything. A warm run with no edits parses NOTHING (PARSE_COUNT is
+# the proof the cache test pins).
+
+def _rules_hash() -> str:
+    h = hashlib.sha256()
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(os.listdir(pkg_dir)):
+        if fn.endswith(".py"):
+            h.update(fn.encode())
+            with open(os.path.join(pkg_dir, fn), "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def _load_cache(root: str, rhash: str) -> dict:
+    path = os.path.join(root, CACHE_NAME)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") == 1 and data.get("rules_hash") == rhash:
+            return data
+    except (OSError, ValueError):
+        pass
+    return {"version": 1, "rules_hash": rhash, "files": {},
+            "project": {}}
+
+
+def _save_cache(root: str, cache: dict) -> None:
+    path = os.path.join(root, CACHE_NAME)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(cache, f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
 # -- driver ------------------------------------------------------------------
 
 def run_lint(paths: Iterable[str], root: str | None = None,
              rules: Iterable[str] | None = None,
-             changed_only: bool = False) -> list[Finding]:
+             changed_only: bool = False,
+             use_cache: bool = True) -> list[Finding]:
     """Run the suite: per-file rules on each module (restricted to
     changed files in changed-only mode), then project rules over the
     full set (cross-file consistency needs the whole picture even for
-    an incremental run). Suppressions apply to both."""
+    an incremental run). Suppressions apply to both. Results come from
+    the findings cache wherever file bytes and linter sources are
+    unchanged; pass use_cache=False to force a cold run."""
     # load the checker modules so their @rule decorators run
     from ceph_tpu.tools.radoslint import (checkers, lifetimes,  # noqa: F401
-                                          project)
+                                          lockorder, project)
     root = os.path.abspath(root or os.getcwd())
     wanted = set(rules) if rules is not None else set(RULES)
     unknown = wanted - set(RULES)
     if unknown:
         raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+    file_rules = sorted(rid for rid in wanted
+                        if RULES[rid].kind == "file")
+    proj_rules = sorted(rid for rid in wanted
+                        if RULES[rid].kind == "project")
     raw = collect_files(paths, root)
-    files: list[SourceFile] = []
-    findings: list[Finding] = []
-    for ap, rel, src in raw:
-        try:
-            files.append(SourceFile(ap, rel, src))
-        except SyntaxError as e:
-            findings.append(Finding(rel, e.lineno or 0, "parse-error",
-                                    f"cannot parse: {e.msg}"))
+    cache = _load_cache(root, _rules_hash()) if use_cache else \
+        {"version": 1, "files": {}, "project": {}}
+    dirty = False
+
     changed = git_changed_files(root) if changed_only else None
-    per_file = files if changed is None else \
-        [sf for sf in files if sf.path in changed]
-    by_path = {sf.path: sf for sf in files}
-    for r in RULES.values():
-        if r.id not in wanted:
+    findings: list[Finding] = []
+    parsed: dict[str, SourceFile | None] = {}   # None = syntax error
+
+    def ensure_parsed(ap: str, rel: str, src: str,
+                      entry: dict) -> SourceFile | None:
+        nonlocal dirty
+        if rel in parsed:
+            return parsed[rel]
+        try:
+            sf = SourceFile(ap, rel, src)
+        except SyntaxError as e:
+            sf = None
+            if entry["parse_error"] is None:
+                entry["parse_error"] = [e.lineno or 0,
+                                        f"cannot parse: {e.msg}"]
+                dirty = True
+        parsed[rel] = sf
+        return sf
+
+    # -- per-file phase ------------------------------------------------------
+    entries: dict[str, dict] = {}
+    for ap, rel, src in raw:
+        h = hashlib.sha256(src.encode("utf-8", "replace")).hexdigest()
+        entry = cache["files"].get(rel)
+        if entry is None or entry.get("hash") != h:
+            try:
+                st = os.stat(ap)
+                mtime, size = st.st_mtime, st.st_size
+            except OSError:
+                mtime, size = 0, len(src)
+            entry = {"hash": h, "mtime": mtime, "size": size,
+                     "parse_error": None, "rules": {}}
+            cache["files"][rel] = entry
+            dirty = True
+            # a changed file must establish parseability now even when
+            # out of changed-only scope: parse-error findings have
+            # always covered the whole collected set
+            ensure_parsed(ap, rel, src, entry)
+        entries[rel] = entry
+        if entry["parse_error"] is not None:
+            ln, msg = entry["parse_error"]
+            findings.append(Finding(rel, ln, "parse-error", msg))
             continue
-        if r.kind == "file":
-            for sf in per_file:
-                findings.extend(r.fn(sf))
-        else:
-            findings.extend(r.fn(files))
-    out = []
-    for f in findings:
-        sf = by_path.get(f.path)
-        if sf is not None and sf.suppressed(f.rule, f.line, f.end_line):
+        if changed is not None and rel not in changed:
             continue
-        out.append(f)
-    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
-    return out
+        missing = [rid for rid in file_rules
+                   if rid not in entry["rules"]]
+        if missing:
+            sf = ensure_parsed(ap, rel, src, entry)
+            if sf is None:
+                ln, msg = entry["parse_error"]
+                findings.append(Finding(rel, ln, "parse-error", msg))
+                continue
+            for rid in missing:
+                kept = [f for f in RULES[rid].fn(sf)
+                        if not sf.suppressed(f.rule, f.line, f.end_line)]
+                entry["rules"][rid] = [
+                    [f.line, f.message, f.end_line] for f in kept]
+                dirty = True
+        for rid in file_rules:
+            findings.extend(
+                Finding(rel, ln, rid, msg, end_line=el)
+                for ln, msg, el in entry["rules"][rid])
+
+    # -- project phase -------------------------------------------------------
+    if proj_rules:
+        stamp = hashlib.sha256()
+        for ap, rel, src in raw:
+            stamp.update(rel.encode())
+            stamp.update(entries[rel]["hash"].encode())
+        stamp = stamp.hexdigest()
+        pcache = cache["project"]
+        if pcache.get("stamp") != stamp:
+            pcache = cache["project"] = {"stamp": stamp, "rules": {}}
+            dirty = True
+        missing = [rid for rid in proj_rules
+                   if rid not in pcache["rules"]]
+        if missing:
+            files = [sf for ap, rel, src in raw
+                     if (sf := ensure_parsed(ap, rel, src,
+                                             entries[rel])) is not None]
+            by_path = {sf.path: sf for sf in files}
+            for rid in missing:
+                kept = []
+                for f in RULES[rid].fn(files):
+                    sf = by_path.get(f.path)
+                    if sf is not None and sf.suppressed(
+                            f.rule, f.line, f.end_line):
+                        continue
+                    kept.append([f.path, f.line, f.message, f.end_line])
+                pcache["rules"][rid] = kept
+                dirty = True
+        for rid in proj_rules:
+            findings.extend(
+                Finding(p, ln, rid, msg, end_line=el)
+                for p, ln, msg, el in pcache["rules"][rid])
+
+    if use_cache and dirty:
+        _save_cache(root, cache)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings

@@ -110,6 +110,14 @@ class AdminSocket:
         self.register_command("profile reset",
                               lambda req: loopprof.reset(),
                               "zero the loop profiler's samples")
+        from ceph_tpu.utils import sanitizer
+        self.register_command(
+            "deadlock dump",
+            lambda req: sanitizer.deadlock_dump(),
+            "lockdep state: order graph size, retained inversions, "
+            "live lock/grant waits + holders with task spawn sites, "
+            "parked-task census, and a fresh wait-for-graph cycle scan "
+            "(arm with config set sanitizer_lockdep true)")
         if self.config is not None:
             self.register_command("config show",
                                   lambda req: self.config.show(),

@@ -49,7 +49,13 @@ async def reap(task: asyncio.Task | None) -> None:
         return
     task.cancel()
     try:
-        await task
+        # shield: a cancel aimed at US must not be delivered by
+        # cancelling `task` (Task.cancel() cancels the awaited future —
+        # without the shield that IS `task`, which then finishes
+        # cancelled and makes our own cancellation indistinguishable
+        # from the reaped task's on 3.10, where being_cancelled() is
+        # blind). With the shield, `task.done()` is a reliable witness.
+        await asyncio.shield(task)
     except asyncio.CancelledError:
         # two sources: the reaped task finishing cancelled (swallow) or
         # our own wait being interrupted (propagate). If the reaped
@@ -104,7 +110,11 @@ async def drain(task: asyncio.Task | None) -> None:
     if task is None:
         return
     try:
-        await task
+        # shield, for two reasons: cancelling US must not collaterally
+        # cancel the task we promised to await WITHOUT cancelling, and
+        # (as in reap) it keeps `task.done()` a reliable witness of
+        # whose CancelledError this is on 3.10.
+        await asyncio.shield(task)
     except asyncio.CancelledError:
         if being_cancelled() or not task.done():
             raise

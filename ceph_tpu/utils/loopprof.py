@@ -227,6 +227,43 @@ def installed_loops() -> list:
         return [lp for lp in _loops if not lp.is_closed()]
 
 
+def parked_tasks(limit: int = 64) -> list[dict]:
+    """Census of pending tasks across every tracked loop, each with its
+    spawn site and current suspension point: the deadlock watchdog's
+    `deadlock dump` lays this next to the registered lock/grant waits so
+    an operator sees what ELSE is parked around a cycle. Best-effort
+    cross-thread read — all_tasks retries its weak-set snapshot and the
+    coroutine frame walk is a GIL-safe peek."""
+    loops: set = set()
+    with _lock:
+        loops.update(lp for lp in _loops if not lp.is_closed())
+    loops.update(lp for lp in list(_tracked_loops) if not lp.is_closed())
+    out: list[dict] = []
+    for lp in loops:
+        try:
+            tasks = asyncio.all_tasks(lp)
+        except RuntimeError:
+            continue
+        for t in tasks:
+            if t.done():
+                continue
+            entry = {"task": t.get_name(),
+                     "spawn_site": sanitizer.spawn_site(t)}
+            try:
+                frames = t.get_stack(limit=1)
+                if frames:
+                    f = frames[-1]
+                    entry["parked_at"] = (
+                        f"{f.f_code.co_filename}:{f.f_lineno} "
+                        f"in {f.f_code.co_name}")
+            except Exception:
+                pass
+            out.append(entry)
+            if len(out) >= limit:
+                return out
+    return out
+
+
 # -- surfaces ----------------------------------------------------------------
 
 def _executor_depth() -> int:

@@ -46,12 +46,14 @@ Counters live in the process-wide PerfCountersCollection under the
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import sys
 import threading
+import time
 import weakref
 
-from ceph_tpu.utils import loophook
+from ceph_tpu.utils import flight, loophook
 from ceph_tpu.utils.dout import dout
 from ceph_tpu.utils.perf_counters import PerfCountersCollection
 
@@ -99,6 +101,12 @@ def perf():
             pc.add("san_foreign_call_soon",
                    description="loop.call_soon driven from a thread "
                                "that does not own the loop")
+            pc.add("san_lock_order_edges",
+                   description="distinct lock-acquisition-order edges "
+                               "recorded by lockdep")
+            pc.add("san_lockdep_inversions",
+                   description="lock-order cycles detected at acquire "
+                               "time (each a latent deadlock)")
         _perf = pc
     return _perf
 
@@ -230,7 +238,17 @@ def register_config(config) -> None:
                 Option("sanitizer_view_guards", "bool", True,
                        "wrap pooled-buffer views in generation guards "
                        "while the sanitizer is armed (use-after-recycle "
-                       "raises at the access site)")):
+                       "raises at the access site)"),
+                Option("sanitizer_lockdep", "bool", False,
+                       "arm the lock-order graph recorder + the "
+                       "wait-for-graph deadlock watchdog (TrackedLock, "
+                       "AdjustableSemaphore, Throttle acquisitions)"),
+                Option("sanitizer_stuck_wait_s", "float",
+                       DEFAULT_STUCK_WAIT_S,
+                       "age threshold after which a parked lock/grant "
+                       "wait is reported as stuck by the deadlock "
+                       "watchdog (and annotated in MgrReports)",
+                       minimum=0.05)):
         try:
             config.declare(opt)
         except ConfigError:
@@ -249,6 +267,16 @@ def register_config(config) -> None:
             set_view_guards(bool(value))
 
     def _on_change(name: str, value) -> None:
+        # lockdep state is process-wide and thread-safe: no loop
+        # marshalling needed, a `config set` from the admin-socket
+        # thread arms/retunes it directly
+        if name == "sanitizer_lockdep":
+            set_lockdep(bool(value),
+                        stuck_wait_s=config.get("sanitizer_stuck_wait_s"))
+            return
+        if name == "sanitizer_stuck_wait_s":
+            set_stuck_wait_s(float(value))
+            return
         try:
             _apply(asyncio.get_running_loop(), name, value)
         except RuntimeError:
@@ -260,7 +288,8 @@ def register_config(config) -> None:
                     loop.call_soon_threadsafe(_apply, loop, name, value)
 
     config.add_observer(("sanitizer_enabled", "sanitizer_slow_callback_s",
-                         "sanitizer_view_guards"), _on_change)
+                         "sanitizer_view_guards", "sanitizer_lockdep",
+                         "sanitizer_stuck_wait_s"), _on_change)
 
 
 # -- buffer generation guards -------------------------------------------------
@@ -508,13 +537,26 @@ class TrackedLock:
         return held
 
     def acquire(self, *a, **kw) -> bool:
+        if _lockdep_on:
+            # BEFORE blocking: the order edge exists the moment the
+            # attempt is made, which is what catches an inversion while
+            # both parties are still parked rather than after the fact
+            lockdep_will_lock(self.name)
+            token = lockdep_wait_start(self.name, kind="lock")
+        else:
+            token = None
         ok = self._lock.acquire(*a, **kw)
+        lockdep_wait_end(token)
         if ok:
             self._held().add(self)
+            if _lockdep_on:
+                lockdep_locked(self.name)
         return ok
 
     def release(self) -> None:
         self._held().discard(self)
+        if _lockdep_on:
+            lockdep_unlocked(self.name)
         self._lock.release()
 
     def locked(self) -> bool:
@@ -625,6 +667,496 @@ def clear_lockset_conflicts() -> None:
         _reported_pairs.clear()
 
 
+# -- lockdep: acquisition-order graph + wait-for-graph watchdog ---------------
+#
+# The reference's src/common/lockdep.cc keeps a global lock-order graph
+# and fails fast when an acquisition would close a cycle. Here the same
+# graph is keyed by resource NAME (TrackedLock.name, Throttle.name, an
+# AdjustableSemaphore's name) and fed at acquire-ATTEMPT time, so an
+# inversion is reported while both parties are still parked. On top of
+# the static order graph sits a live wait-for graph: every blocking
+# acquire registers (context, resource, since) and every successful one
+# registers a holder, so a periodic watchdog sweep can walk
+# waiter -> resource -> holder edges and name an actual deadlock cycle
+# (with task spawn sites) rather than just a latent ordering hazard.
+# "Context" is the running asyncio task when there is one, else the
+# thread — the same execution-context notion the lockset recorder uses,
+# extended to coroutines.
+
+DEFAULT_STUCK_WAIT_S = 5.0
+
+_lockdep_lock = threading.Lock()
+_lockdep_on = False
+_stuck_wait_s = DEFAULT_STUCK_WAIT_S
+#: (before, after) -> first-witness {"site": str}
+_order_edges: dict[tuple[str, str], dict] = {}
+_order_succ: dict[str, set[str]] = {}          # before -> {after, ...}
+_inversions: list[dict] = []
+_INVERSION_CAP = 64
+_reported_cycles: set[frozenset] = set()
+#: resource name -> {ctx_id: {"ctx": label, "count": n, "site": str}}
+_holders: dict[str, dict[int, dict]] = {}
+#: wait token -> {"ctx", "ctx_name", "resource", ...}
+_waits: dict[int, dict] = {}
+_wait_seq = 0
+_thread_held = threading.local()
+_watchdog: "_DeadlockWatchdog | None" = None
+_last_scan: dict = {}
+
+
+def lockdep_enabled() -> bool:
+    return _lockdep_on
+
+
+def _caller_site(skip: int = 2) -> str:
+    """file:line of the nearest non-sanitizer, non-asyncio caller —
+    raw frame walk, same rationale as the task factory."""
+    f = sys._getframe(skip)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if "/asyncio/" not in fn and not fn.endswith("sanitizer.py") \
+                and not fn.endswith("throttle.py"):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "?"
+
+
+def _ctx() -> tuple[int, str, list]:
+    """(context id, context label, held-resource list) for the current
+    execution context: the running task inside a coroutine, else the
+    thread. The held list lives on the task/thread object so it follows
+    the context across awaits."""
+    task = None
+    try:
+        task = asyncio.current_task()
+    except RuntimeError:
+        pass
+    if task is not None:
+        held = getattr(task, "_san_lockdep_held", None)
+        if held is None:
+            held = []
+            task._san_lockdep_held = held
+        return id(task), f"task:{task.get_name()}", held
+    held = getattr(_thread_held, "held", None)
+    if held is None:
+        held = _thread_held.held = []
+    t = threading.current_thread()
+    return threading.get_ident(), f"thread:{t.name}", held
+
+
+def set_stuck_wait_s(value: float) -> None:
+    global _stuck_wait_s
+    _stuck_wait_s = max(0.05, float(value))
+
+
+def set_lockdep(enabled: bool, stuck_wait_s: float | None = None) -> None:
+    """Arm/disarm the order-graph recorder and the deadlock watchdog.
+    Arming clears previous graph state (same id-recycling argument as
+    the lockset recorder: names persist, contexts do not)."""
+    global _lockdep_on, _watchdog
+    if stuck_wait_s is not None:
+        set_stuck_wait_s(stuck_wait_s)
+    enabled = bool(enabled)
+    with _lockdep_lock:
+        if enabled == _lockdep_on:
+            pass
+        elif enabled:
+            _order_edges.clear()
+            _order_succ.clear()
+            _inversions.clear()
+            _reported_cycles.clear()
+            _holders.clear()
+            _waits.clear()
+            _last_scan.clear()
+    _lockdep_on = enabled
+    if enabled and (_watchdog is None or not _watchdog.is_alive()):
+        _watchdog = _DeadlockWatchdog()
+        _watchdog.start()
+    elif not enabled and _watchdog is not None:
+        _watchdog.stop()
+        _watchdog = None
+    if enabled:
+        perf()                      # counters exist as soon as armed
+    dout("san", 2, f"lockdep {'armed' if enabled else 'disarmed'} "
+                   f"(stuck-wait threshold {_stuck_wait_s}s)")
+
+
+def lockdep_will_lock(name: str) -> None:
+    """Record order edges held->name for every resource the current
+    context holds; a new edge that closes a cycle in the order graph is
+    an inversion (reported once per distinct cycle)."""
+    if not _lockdep_on:
+        return
+    _, ctx_name, held = _ctx()
+    if not held:
+        return
+    site = _caller_site()
+    for h in held:
+        if h != name:
+            _note_order_edge(h, name, ctx_name, site)
+
+
+def _note_order_edge(before: str, after: str, ctx_name: str,
+                     site: str) -> None:
+    with _lockdep_lock:
+        if (before, after) in _order_edges:
+            return
+        _order_edges[(before, after)] = {"site": site, "ctx": ctx_name}
+        _order_succ.setdefault(before, set()).add(after)
+        perf().inc("san_lock_order_edges")
+        # does `after` already reach `before`? then this edge closes a
+        # cycle: BFS for the reverse path so the witness can be
+        # rendered edge by edge
+        path = _find_path(after, before)
+        if path is None:
+            return
+        cycle_edges = [(path[i], path[i + 1])
+                       for i in range(len(path) - 1)] + [(before, after)]
+        key = frozenset(cycle_edges)
+        if key in _reported_cycles:
+            return
+        _reported_cycles.add(key)
+        perf().inc("san_lockdep_inversions")
+        witness = [{"before": a, "after": b,
+                    "site": _order_edges.get((a, b), {}).get("site", "?"),
+                    "ctx": _order_edges.get((a, b), {}).get("ctx", "?")}
+                   for a, b in cycle_edges]
+        digest = _cycle_digest([e[0] for e in cycle_edges])
+        inv = {"cycle": path + [after], "edges": witness,
+               "digest": digest, "detected_at": site,
+               "detected_by": ctx_name}
+        if len(_inversions) < _INVERSION_CAP:
+            _inversions.append(inv)
+    flight.record("lockdep_inversion", ctx_name, digest=digest,
+                  cycle=inv["cycle"],
+                  edges=[f"{e['before']}->{e['after']} at {e['site']}"
+                         for e in witness])
+    dout("san", 0,
+         "lockdep: lock-order inversion "
+         + " -> ".join(inv["cycle"]) + " — "
+         + "; ".join(f"{e['before']}->{e['after']} at {e['site']} "
+                     f"({e['ctx']})" for e in witness))
+
+
+def _find_path(src: str, dst: str) -> list | None:
+    """BFS path src..dst over the order graph (caller holds the lock)."""
+    if src == dst:
+        return [src]
+    prev: dict[str, str] = {src: src}
+    frontier = [src]
+    while frontier:
+        nxt = []
+        for node in frontier:
+            for succ in _order_succ.get(node, ()):
+                if succ in prev:
+                    continue
+                prev[succ] = node
+                if succ == dst:
+                    path = [dst]
+                    while path[-1] != src:
+                        path.append(prev[path[-1]])
+                    return path[::-1]
+                nxt.append(succ)
+        frontier = nxt
+    return None
+
+
+def _cycle_digest(resources: list) -> str:
+    """Deterministic cycle fingerprint: the resource ring rotated to
+    its lexicographically smallest phase, hashed. Task/thread labels
+    are deliberately excluded — the digest must be bit-identical across
+    replays of the same seeded scenario, and context names are not."""
+    if not resources:
+        return hashlib.sha256(b"").hexdigest()[:16]
+    k = resources.index(min(resources))
+    ring = resources[k:] + resources[:k]
+    return hashlib.sha256("|".join(ring).encode()).hexdigest()[:16]
+
+
+def lockdep_locked(name: str) -> None:
+    if not _lockdep_on:
+        return
+    ctx_id, ctx_name, held = _ctx()
+    held.append(name)
+    with _lockdep_lock:
+        ent = _holders.setdefault(name, {}).get(ctx_id)
+        if ent is None:
+            _holders[name][ctx_id] = {"ctx": ctx_name, "count": 1,
+                                      "site": _caller_site()}
+        else:
+            ent["count"] += 1
+
+
+def lockdep_unlocked(name: str) -> None:
+    if not _lockdep_on:
+        return
+    ctx_id, _, held = _ctx()
+    # remove the LAST occurrence: counted resources nest
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == name:
+            del held[i]
+            break
+    with _lockdep_lock:
+        by_ctx = _holders.get(name, {})
+        hid = ctx_id
+        if hid not in by_ctx and by_ctx:
+            # semaphore handed across contexts (acquired by one task,
+            # released by another): charge ANY holder entry — holder
+            # identity is diagnostic, the count must not leak
+            hid = next(iter(by_ctx))
+        ent = by_ctx.get(hid)
+        if ent is not None:
+            ent["count"] -= 1
+            if ent["count"] <= 0:
+                del by_ctx[hid]
+                if not by_ctx:
+                    _holders.pop(name, None)
+
+
+def lockdep_wait_start(resource: str, kind: str = "lock",
+                       **detail) -> int | None:
+    """Register a blocking wait on `resource` in the live wait-for
+    graph; returns a token for lockdep_wait_end. `detail` carries
+    attribution (entity=..., peer=..., tid=...) the distributed probe
+    ships in MgrReports."""
+    if not _lockdep_on:
+        return None
+    global _wait_seq
+    ctx_id, ctx_name, held = _ctx()
+    spawn = None
+    try:
+        task = asyncio.current_task()
+        if task is not None:
+            spawn = spawn_site(task)
+    except RuntimeError:
+        pass
+    with _lockdep_lock:
+        _wait_seq += 1
+        token = _wait_seq
+        _waits[token] = {"ctx": ctx_id, "ctx_name": ctx_name,
+                         "resource": resource, "kind": kind,
+                         "since": time.monotonic(),
+                         "held": list(held), "site": _caller_site(),
+                         "spawn_site": spawn, "detail": detail}
+    return token
+
+
+def lockdep_wait_end(token: int | None) -> None:
+    if token is None:
+        return
+    with _lockdep_lock:
+        _waits.pop(token, None)
+
+
+def lockdep_inversions() -> list[dict]:
+    with _lockdep_lock:
+        return [dict(i) for i in _inversions]
+
+
+def lockdep_order_edges() -> dict:
+    with _lockdep_lock:
+        return {f"{a} -> {b}": dict(w)
+                for (a, b), w in _order_edges.items()}
+
+
+def deadlock_scan(stuck_s: float | None = None) -> dict:
+    """One sweep of the live wait-for graph: waiter-context ->
+    resource -> holder-context edges, cycles among them, and
+    age-threshold stuck waits. Pure read — safe from any thread (the
+    watchdog's tick and the `deadlock dump` verb both call it)."""
+    if stuck_s is None:
+        stuck_s = _stuck_wait_s
+    now = time.monotonic()
+    with _lockdep_lock:
+        waits = [dict(w) for w in _waits.values()]
+        holders = {res: {cid: dict(e) for cid, e in by.items()}
+                   for res, by in _holders.items()}
+    ctx_names: dict[int, str] = {}
+    edges = []                   # (waiter_ctx, resource, holder_ctx)
+    adj: dict[int, list] = {}
+    for w in waits:
+        ctx_names[w["ctx"]] = w["ctx_name"]
+        for hid, ent in holders.get(w["resource"], {}).items():
+            ctx_names.setdefault(hid, ent["ctx"])
+            if hid == w["ctx"]:
+                continue         # re-entry, not a wait-for edge
+            edges.append((w["ctx"], w["resource"], hid, w))
+            adj.setdefault(w["ctx"], []).append((hid, w["resource"], w))
+    cycles, seen_keys = [], set()
+    for start in adj:
+        path: list[tuple] = []
+        on_path: dict[int, int] = {}
+
+        def dfs(ctx) -> None:
+            if ctx in on_path:
+                loop_part = path[on_path[ctx]:]
+                resources = [res for _, res, _ in loop_part]
+                key = frozenset((c, r) for c, r, _ in loop_part)
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append({
+                        "tasks": [ctx_names.get(c, str(c))
+                                  for c, _, _ in loop_part],
+                        "resources": resources,
+                        "digest": _cycle_digest(resources),
+                        "edges": [{
+                            "waiter": ctx_names.get(c, str(c)),
+                            "resource": r,
+                            "holder": ctx_names.get(h, str(h)),
+                            "waited_s": round(now - w["since"], 3),
+                            "site": w["site"],
+                            "spawn_site": w.get("spawn_site"),
+                            "detail": w.get("detail") or {}}
+                            for (c, r, w), (h, _, _) in zip(
+                                loop_part,
+                                loop_part[1:] + loop_part[:1])],
+                    })
+                return
+            if ctx not in adj:
+                return
+            on_path[ctx] = len(path)
+            for hid, res, w in adj[ctx]:
+                path.append((ctx, res, w))
+                dfs(hid)
+                path.pop()
+            del on_path[ctx]
+
+        dfs(start)
+    stuck = [{"ctx": w["ctx_name"], "resource": w["resource"],
+              "kind": w["kind"], "age_s": round(now - w["since"], 3),
+              "site": w["site"], "spawn_site": w.get("spawn_site"),
+              "held": w["held"], "detail": w.get("detail") or {}}
+             for w in waits if now - w["since"] >= stuck_s]
+    return {"waits": len(waits), "edges": len(edges),
+            "cycles": cycles, "stuck": stuck,
+            "stuck_wait_s": stuck_s}
+
+
+def wait_annotations(entity: str | None = None,
+                     min_age_s: float | None = None) -> list[dict]:
+    """Long-parked waits for the distributed probe: each OSD ships the
+    ones it owns (detail entity= matches) in its MgrReport health leg,
+    so the mgr can assemble the cross-daemon wait-for graph."""
+    if not _lockdep_on:
+        return []
+    if min_age_s is None:
+        min_age_s = _stuck_wait_s
+    now = time.monotonic()
+    out = []
+    with _lockdep_lock:
+        waits = [dict(w) for w in _waits.values()]
+    for w in waits:
+        age = now - w["since"]
+        if age < min_age_s:
+            continue
+        detail = w.get("detail") or {}
+        if entity is not None and detail.get("entity") != entity:
+            continue
+        out.append({"entity": detail.get("entity"),
+                    "resource": w["resource"], "kind": w["kind"],
+                    "age_s": round(age, 3), "task": w["ctx_name"],
+                    "peer": detail.get("peer"),
+                    "tid": detail.get("tid"),
+                    "site": w["site"],
+                    "spawn_site": w.get("spawn_site")})
+    return out
+
+
+def deadlock_dump() -> dict:
+    """The `deadlock dump` admin-socket verb: lockdep graph stats,
+    retained inversions, live waits/holders with task spawn sites, the
+    watchdog's last detection, and a fresh scan."""
+    with _lockdep_lock:
+        waits = [dict(w) for w in _waits.values()]
+        holders = {res: [dict(e) for e in by.values()]
+                   for res, by in _holders.items()}
+        inversions = [dict(i) for i in _inversions]
+        n_edges = len(_order_edges)
+        last = dict(_last_scan)
+    now = time.monotonic()
+    for w in waits:
+        w["age_s"] = round(now - w.pop("since"), 3)
+        w.pop("ctx", None)
+    # parked-task census from the loopprof/task-factory mirrors: shows
+    # what ELSE is parked next to the registered waits
+    try:
+        from ceph_tpu.utils import loopprof
+        parked = loopprof.parked_tasks()
+    except Exception:
+        parked = []
+    return {"lockdep": _lockdep_on,
+            "stuck_wait_s": _stuck_wait_s,
+            "order_edges": n_edges,
+            "inversions": inversions,
+            "waits": waits,
+            "holders": holders,
+            "parked_tasks": parked,
+            "last_detection": last,
+            "scan": deadlock_scan()}
+
+
+class _DeadlockWatchdog(threading.Thread):
+    """Periodic wait-for-graph sweep: a detected cycle or an over-age
+    stuck wait drops a flight crumb + dout once per distinct signature,
+    and the latest positive scan is retained for `deadlock dump`."""
+
+    def __init__(self):
+        super().__init__(name="san-deadlock-watchdog", daemon=True)
+        self._stop = threading.Event()
+        self._crumbed: set[str] = set()
+        self._stuck_crumbed: set[tuple] = set()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            # sweep well inside the detection budget (<2s from park to
+            # report even with the default 5s stuck threshold, since
+            # cycle detection does not wait for the age threshold)
+            self._stop.wait(min(0.5, _stuck_wait_s / 2))
+            if self._stop.is_set() or not _lockdep_on:
+                continue
+            try:
+                scan = deadlock_scan()
+            except Exception as e:
+                dout("san", 1, f"deadlock watchdog sweep failed: "
+                               f"{type(e).__name__} {e}")
+                continue
+            if scan["cycles"] or scan["stuck"]:
+                with _lockdep_lock:
+                    _last_scan.clear()
+                    _last_scan.update(scan, stamp=time.time())
+            for cyc in scan["cycles"]:
+                if cyc["digest"] in self._crumbed:
+                    continue
+                self._crumbed.add(cyc["digest"])
+                flight.record(
+                    "deadlock_cycle", "lockdep",
+                    digest=cyc["digest"], resources=cyc["resources"],
+                    tasks=cyc["tasks"],
+                    edges=[f"{e['waiter']} waits {e['resource']} "
+                           f"held by {e['holder']}"
+                           for e in cyc["edges"]])
+                dout("san", 0,
+                     "DEADLOCK: " + " ; ".join(
+                         f"{e['waiter']} waits on {e['resource']} "
+                         f"held by {e['holder']} "
+                         f"(spawned {e['spawn_site']})"
+                         for e in cyc["edges"]))
+            for s in scan["stuck"]:
+                key = (s["ctx"], s["resource"])
+                if key in self._stuck_crumbed:
+                    continue
+                self._stuck_crumbed.add(key)
+                flight.record("stuck_wait", s["ctx"],
+                              resource=s["resource"], age_s=s["age_s"],
+                              site=s["site"], detail=s["detail"])
+                dout("san", 1,
+                     f"stuck wait: {s['ctx']} parked on "
+                     f"{s['resource']} for {s['age_s']}s at {s['site']}")
+
+
 # -- foreign-loop call_soon recorder ------------------------------------------
 
 _foreign_lock = threading.Lock()
@@ -695,5 +1227,8 @@ def maybe_install(config=None) -> None:
         if config.get("sanitizer_enabled"):
             install(slow_callback_s=config.get("sanitizer_slow_callback_s"),
                     view_guards=config.get("sanitizer_view_guards"))
+        if config.get("sanitizer_lockdep"):
+            set_lockdep(True,
+                        stuck_wait_s=config.get("sanitizer_stuck_wait_s"))
     except Exception:
         pass                            # options not declared on this config

@@ -15,6 +15,8 @@ import asyncio
 import threading
 import time
 
+from ceph_tpu.utils import sanitizer
+
 
 class AdjustableSemaphore(asyncio.Semaphore):
     """asyncio.Semaphore whose slot count can be resized while held.
@@ -31,8 +33,15 @@ class AdjustableSemaphore(asyncio.Semaphore):
     owning event loop's thread.
     """
 
-    def __init__(self, value: int):
+    def __init__(self, value: int, name: str | None = None):
         super().__init__(value)
+        #: lockdep identity: named semaphores register every acquire
+        #: with the sanitizer's order graph + wait-for graph exactly
+        #: like make_lock() locks; anonymous ones stay untracked
+        self.name = name
+        #: attribution merged into the wait record (entity=..., so the
+        #: distributed probe can ship this wait in MgrReports)
+        self.lockdep_detail: dict = {}
         self._limit = value
         self._debt = 0      # releases to absorb instead of freeing
         #: the loop the semaphore is bound to, captured at first
@@ -47,7 +56,40 @@ class AdjustableSemaphore(asyncio.Semaphore):
     async def acquire(self) -> bool:
         if self._owner_loop is None:
             self._owner_loop = asyncio.get_running_loop()
-        return await super().acquire()
+        if self.name is None or not sanitizer.lockdep_enabled():
+            return await super().acquire()
+        sanitizer.lockdep_will_lock(self.name)
+        token = sanitizer.lockdep_wait_start(self.name, kind="semaphore",
+                                             **self.lockdep_detail)
+        try:
+            ok = await super().acquire()
+        finally:
+            sanitizer.lockdep_wait_end(token)
+        if ok:
+            sanitizer.lockdep_locked(self.name)
+        return ok
+
+    async def acquire_timeout(self, timeout: float) -> bool:
+        """Bounded acquire that keeps lockdep attribution in THIS
+        context. `asyncio.wait_for(sem.acquire(), t)` runs acquire()
+        inside an ephemeral wrapper task, so the hold would be charged
+        to a context that is already dead — and a wait-for-graph cycle
+        through this semaphore could never close on the real holder.
+        Raises asyncio.TimeoutError like wait_for."""
+        if self._owner_loop is None:
+            self._owner_loop = asyncio.get_running_loop()
+        if self.name is None or not sanitizer.lockdep_enabled():
+            return await asyncio.wait_for(super().acquire(), timeout)
+        sanitizer.lockdep_will_lock(self.name)
+        token = sanitizer.lockdep_wait_start(self.name, kind="semaphore",
+                                             **self.lockdep_detail)
+        try:
+            ok = await asyncio.wait_for(super().acquire(), timeout)
+        finally:
+            sanitizer.lockdep_wait_end(token)
+        if ok:
+            sanitizer.lockdep_locked(self.name)
+        return ok
 
     @property
     def limit(self) -> int:
@@ -88,6 +130,10 @@ class AdjustableSemaphore(asyncio.Semaphore):
             self._debt += shrink - take_now
 
     def release(self) -> None:
+        if self.name is not None and sanitizer.lockdep_enabled():
+            # in the RELEASER's context: lockdep falls back to any
+            # holder entry when a semaphore is handed across contexts
+            sanitizer.lockdep_unlocked(self.name)
         if self._foreign_caller():
             # acquired on shard A, released on shard B: hand the
             # release to the owning loop whole (count mutation AND
@@ -108,6 +154,9 @@ class Throttle:
 
     def __init__(self, name: str, max_count: int):
         self.name = name
+        #: lockdep resource identity — prefixed so a Throttle can never
+        #: alias a TrackedLock/semaphore of the same short name
+        self._lockdep_name = f"throttle:{name}"
         self._max = max_count
         self._count = 0
         self._cond = threading.Condition()
@@ -130,31 +179,50 @@ class Throttle:
     def get(self, count: int = 1, timeout: float | None = None) -> bool:
         """Block until `count` units fit (or timeout). Requests larger than
         the whole budget are admitted alone, like the reference."""
+        tracked = sanitizer.lockdep_enabled()
+        if tracked:
+            sanitizer.lockdep_will_lock(self._lockdep_name)
+        token = None
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._cond:
-            while not self._fits(count):
-                remaining = None if deadline is None else \
-                    deadline - time.monotonic()
-                if remaining is not None and remaining <= 0:
-                    return False
-                self._cond.wait(remaining)
-            self._count += count
-            return True
+        try:
+            with self._cond:
+                while not self._fits(count):
+                    if tracked and token is None:
+                        token = sanitizer.lockdep_wait_start(
+                            self._lockdep_name, kind="throttle")
+                    remaining = None if deadline is None else \
+                        deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        return False
+                    self._cond.wait(remaining)
+                self._count += count
+        finally:
+            sanitizer.lockdep_wait_end(token)
+        if tracked:
+            sanitizer.lockdep_locked(self._lockdep_name)
+        return True
 
     def take(self, count: int = 1) -> int:
         """Unconditionally take (may exceed max) — reference Throttle::take."""
         with self._cond:
             self._count += count
-            return self._count
+            taken = self._count
+        if sanitizer.lockdep_enabled():
+            sanitizer.lockdep_locked(self._lockdep_name)
+        return taken
 
     def get_or_fail(self, count: int = 1) -> bool:
         with self._cond:
             if not self._fits(count):
                 return False
             self._count += count
-            return True
+        if sanitizer.lockdep_enabled():
+            sanitizer.lockdep_locked(self._lockdep_name)
+        return True
 
     def put(self, count: int = 1) -> int:
+        if sanitizer.lockdep_enabled():
+            sanitizer.lockdep_unlocked(self._lockdep_name)
         with self._cond:
             self._count = max(0, self._count - count)
             self._cond.notify_all()

@@ -1,0 +1,30 @@
+"""Known-negative: grants taken BEFORE gating, and every wait under
+the gate carries a deadline."""
+import asyncio
+
+PEER_TIMEOUT = 10.0
+
+
+async def scrub_range_properly(pg, queue, reply_fut):
+    await pg.qos_grant()             # arbitration happens ungated
+    await pg.block_writes()
+    try:
+        # bounded waits are legal: a stuck peer becomes a timeout
+        await asyncio.wait_for(reply_fut, PEER_TIMEOUT)
+        await queue.get_nowait_batch()
+        await pg.apply_range()       # own work, not an external event
+    finally:
+        pg.unblock_writes()
+
+
+async def apply_under_obj_lock(backend, oid, sem):
+    async with backend.obj_lock(oid):
+        await asyncio.wait_for(sem.acquire(), timeout=5.0)
+        sem.release()
+
+
+async def ungated_wait(pg, queue):
+    await queue.get()                # no gate held: out of scope here
+    await pg.block_writes()
+    pg.unblock_writes()
+    await queue.get()                # gate already dropped

@@ -1,0 +1,17 @@
+"""Known-positive: unbounded external waits while client writes are
+frozen behind a gate or obj_lock."""
+import asyncio
+
+
+async def scrub_range_badly(pg, queue):
+    await pg.block_writes()
+    try:
+        await pg.qos_grant()         # grant with no deadline, gated
+        await queue.get()            # unbounded queue get, gated
+    finally:
+        pg.unblock_writes()
+
+
+async def apply_under_obj_lock(backend, oid, reply_fut):
+    async with backend.obj_lock(oid):
+        await reply_fut              # bare future: no deadline

@@ -1,0 +1,47 @@
+"""Known-negative: consistent global acquisition order everywhere,
+same-named locks on DIFFERENT classes (no aliasing), and an
+unresolvable receiver that must contribute nothing."""
+import threading
+
+_map_lock = threading.Lock()
+_journal_lock = threading.Lock()
+
+
+def flush_map():
+    with _map_lock:                  # always map -> journal
+        with _journal_lock:
+            pass
+
+
+def snapshot():
+    with _map_lock:
+        with _journal_lock:
+            pass
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def evict(self):
+        with self._lock:
+            pass
+
+
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def append(self, cache):
+        # Journal._lock then Cache.evict's Cache._lock — distinct
+        # identities even though both attrs are spelled `_lock`
+        with self._lock:
+            cache.evict()
+
+
+def handoff(peer):
+    # `peer` could be anything: its lock attribute is unresolvable and
+    # must not alias either module lock
+    with peer.some_lock:
+        with _map_lock:
+            pass

@@ -1,0 +1,38 @@
+"""Known-positive: two call paths acquire the same two locks in
+opposite orders — the classic AB/BA inversion, plus a cross-function
+variant where the second acquisition hides inside a callee."""
+import threading
+
+_map_lock = threading.Lock()
+_journal_lock = threading.Lock()
+
+
+def flush_map():
+    with _map_lock:                  # A then B
+        with _journal_lock:
+            pass
+
+
+def flush_journal():
+    with _journal_lock:              # B then A: closes the cycle
+        with _map_lock:
+            pass
+
+
+class Store:
+    def __init__(self):
+        self._cache_lock = threading.Lock()
+        self._disk_lock = threading.Lock()
+
+    def _write_disk(self):
+        with self._disk_lock:
+            pass
+
+    def evict(self):
+        with self._cache_lock:       # cache -> (callee) disk
+            self._write_disk()
+
+    def compact(self):
+        with self._disk_lock:        # disk -> cache: cycle via callee
+            with self._cache_lock:
+                pass

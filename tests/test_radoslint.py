@@ -23,7 +23,7 @@ ALL_RULES = {"detached-task", "blocking-in-coroutine", "await-under-lock",
              "registry-consistency", "decl-use",
              "report-export-consistency",
              "view-escape", "view-across-await", "shard-shared-mutation",
-             "proc-shared-state"}
+             "proc-shared-state", "lock-order-cycle", "await-in-gate"}
 
 
 def lint(path, rules):
@@ -67,6 +67,10 @@ def lint(path, rules):
      "shard_shared_mutation_neg.py"),
     ("proc-shared-state", "proc_shared_state_pos.py", 4,
      "proc_shared_state_neg.py"),
+    ("lock-order-cycle", "lock_order_cycle_pos.py", 2,
+     "lock_order_cycle_neg.py"),
+    ("await-in-gate", "await_in_gate_pos.py", 3,
+     "await_in_gate_neg.py"),
 ])
 def test_rule_fixtures(rule, pos, expected, neg):
     findings = lint(pos, rules=[rule])
@@ -89,12 +93,15 @@ def test_registry_consistency_fixtures():
 
 
 def test_rule_ids_match_registered_set():
-    from ceph_tpu.tools.radoslint import checkers, project  # noqa: F401
+    from ceph_tpu.tools.radoslint import (checkers, lockorder,  # noqa: F401
+                                          project)
     assert set(core.RULES) == ALL_RULES
     kinds = {r.id: r.kind for r in core.RULES.values()}
     assert kinds["registry-consistency"] == "project"
     assert kinds["decl-use"] == "project"
     assert kinds["report-export-consistency"] == "project"
+    assert kinds["lock-order-cycle"] == "project"
+    assert kinds["await-in-gate"] == "file"
 
 
 # -- suppression comments ----------------------------------------------------
@@ -177,6 +184,42 @@ def test_parse_error_becomes_finding(tmp_path):
     p.write_text("def oops(:\n")
     findings = core.run_lint([str(p)], root=str(tmp_path))
     assert [f.rule for f in findings] == ["parse-error"]
+
+
+# -- findings cache ----------------------------------------------------------
+
+def test_cache_warm_run_identical_and_parse_free(tmp_path):
+    """A warm full run must (a) reproduce the cold run's findings
+    byte for byte — including suppressions and parse errors — and
+    (b) parse NOTHING (PARSE_COUNT is the instrument)."""
+    (tmp_path / "bad.py").write_text(
+        "import asyncio\n"
+        "async def f():\n"
+        "    asyncio.create_task(f())\n"
+        "    asyncio.ensure_future(f())  "
+        "# radoslint: disable=detached-task\n")
+    (tmp_path / "broken.py").write_text("def oops(:\n")
+    cold = core.run_lint([str(tmp_path)], root=str(tmp_path))
+    assert {f.rule for f in cold} == {"detached-task", "parse-error"}
+    assert os.path.exists(os.path.join(str(tmp_path), core.CACHE_NAME))
+    before = core.PARSE_COUNT
+    warm = core.run_lint([str(tmp_path)], root=str(tmp_path))
+    assert core.PARSE_COUNT == before, "warm run re-parsed the tree"
+    assert [f.key for f in warm] == [f.key for f in cold]
+    # an uncached run agrees too (the cache changes cost, not truth)
+    nocache = core.run_lint([str(tmp_path)], root=str(tmp_path),
+                            use_cache=False)
+    assert [f.key for f in nocache] == [f.key for f in cold]
+    # editing a file invalidates exactly its entries: the new finding
+    # appears, the fixed one disappears
+    (tmp_path / "bad.py").write_text(
+        "import asyncio\n"
+        "async def g():\n"
+        "    asyncio.ensure_future(g())\n")
+    third = core.run_lint([str(tmp_path)], root=str(tmp_path))
+    assert any(f.rule == "detached-task" and f.line == 3
+               for f in third)
+    assert all(f.path != "bad.py" or f.line == 3 for f in third)
 
 
 # -- lint_tool (ec_tool-style operator surface) ------------------------------
